@@ -1,0 +1,44 @@
+"""gubernator_tpu — a TPU-native distributed rate-limiting framework.
+
+A from-scratch re-design of the capabilities of Gubernator
+(github.com/gubernator-io/gubernator, reference layout surveyed in
+/root/repo/SURVEY.md) for TPU hardware:
+
+* The per-key bucket arithmetic (reference ``algorithms.go``) becomes a
+  branch-free, vectorized state transition over struct-of-arrays bucket
+  state resident in HBM (:mod:`gubernator_tpu.ops.buckets`).
+* The goroutine-per-request worker pool (reference ``workers.go``) becomes
+  a tick-batched device step: requests accumulate on the host and are
+  flushed to the TPU once per tick (:mod:`gubernator_tpu.ops.engine`).
+* The GLOBAL behavior's hit-aggregation / broadcast fabric (reference
+  ``global.go``) becomes collectives (``psum`` / ``all_gather``) over a
+  ``jax.sharding.Mesh`` (:mod:`gubernator_tpu.parallel.global_sync`).
+* The gRPC/HTTP API surface, consistent-hash peering, behaviors, config
+  and observability match the reference's wire contract.
+
+64-bit mode is required: the wire contract is int64 milliseconds /
+int64 hits-limits, and leaky-bucket remaining is float64.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from gubernator_tpu.types import (  # noqa: E402
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitRequest,
+    RateLimitResponse,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitRequest",
+    "RateLimitResponse",
+    "__version__",
+]

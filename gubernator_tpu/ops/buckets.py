@@ -1,0 +1,343 @@
+"""Vectorized token/leaky bucket state transitions.
+
+This is the TPU-native replacement for the reference's per-key, per-goroutine
+``tokenBucket()`` / ``leakyBucket()`` (``algorithms.go:37-257`` and
+``:260-493``): instead of branchy scalar code run once per request, the full
+decision tree is expressed as a branch-free ``jnp.where`` chain evaluated for
+a whole batch of requests at once.  All ~20 distinct outcomes (new item,
+expired item, algorithm switch, limit delta, duration change + renewal,
+Hits==0 status query, exact remainder, over-ask with/without
+DRAIN_OVER_LIMIT, negative hits, RESET_REMAINING) are reproduced with the
+*same precedence* as the reference, including its quirks:
+
+* On a duration-change renewal the response `remaining` reflects the
+  pre-renewal value while the stored state is refilled (algorithms.go:134-147
+  assembles `rl` before the renew mutates `t`).
+* `OVER_LIMIT` is only *persisted* into token-bucket state on the
+  "already at zero" branch (algorithms.go:162-169); the over-ask branch
+  returns OVER_LIMIT without persisting it.
+* Negative hits *add* tokens with no upper clamp for token bucket
+  (TestTokenBucketNegativeHits semantics).
+* A leaky-bucket Hits==0 query that lands on an integer-zero remaining
+  truncates away the fractional remainder (the `int64(b.Remaining) == r.Hits`
+  branch precedes the Hits==0 early return, algorithms.go:398-403).
+* Leaky new items compute `rate` from the *raw* duration even when
+  DURATION_IS_GREGORIAN rewrites the stored duration (algorithms.go:437-450).
+
+State is struct-of-arrays (one array per field over table slots) so the
+transition maps onto the VPU as pure elementwise math after a gather, and
+scatters back afterwards — see :mod:`gubernator_tpu.ops.engine`.
+
+Time is an explicit input: `now` (the tick's wall clock, used for cache
+expiry and Gregorian math like the reference's `clock.Now()`) and the
+per-request `created_at` (client-suppliable, gubernator.proto:172-182).
+Gregorian expirations/durations are resolved host-side
+(:mod:`gubernator_tpu.utils.timeutil`) and passed per request, because
+calendar math doesn't belong on the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+I64 = jnp.int64
+I32 = jnp.int32
+F64 = jnp.float64
+
+
+class BucketState(NamedTuple):
+    """SoA bucket state; each field is an array over table slots (or a gather
+    of them).  Unifies the reference's ``TokenBucketItem`` (store.go:37-43),
+    ``LeakyBucketItem`` (store.go:29-35) and ``CacheItem`` (cache.go:29-41).
+    """
+
+    algorithm: jnp.ndarray  # i32: Algorithm of the stored item
+    limit: jnp.ndarray      # i64
+    remaining: jnp.ndarray  # i64: token-bucket remaining
+    remaining_f: jnp.ndarray  # f64: leaky-bucket remaining (float64 like Go)
+    duration: jnp.ndarray   # i64 ms (raw request duration; leaky new items store the effective one)
+    created_at: jnp.ndarray  # i64 epoch ms (token bucket CreatedAt)
+    updated_at: jnp.ndarray  # i64 epoch ms (leaky bucket UpdatedAt)
+    burst: jnp.ndarray      # i64 (leaky)
+    status: jnp.ndarray     # i32: persisted Status (token bucket only)
+    expire_at: jnp.ndarray  # i64 epoch ms (CacheItem.ExpireAt)
+    in_use: jnp.ndarray     # bool: slot holds a live item
+
+    @classmethod
+    def zeros(cls, n: int) -> "BucketState":
+        return cls(
+            algorithm=jnp.zeros(n, I32),
+            limit=jnp.zeros(n, I64),
+            remaining=jnp.zeros(n, I64),
+            remaining_f=jnp.zeros(n, F64),
+            duration=jnp.zeros(n, I64),
+            created_at=jnp.zeros(n, I64),
+            updated_at=jnp.zeros(n, I64),
+            burst=jnp.zeros(n, I64),
+            status=jnp.zeros(n, I32),
+            expire_at=jnp.zeros(n, I64),
+            in_use=jnp.zeros(n, jnp.bool_),
+        )
+
+
+class ReqBatch(NamedTuple):
+    """One batch of rate-limit requests, already resolved to table slots."""
+
+    slot: jnp.ndarray       # i32: table slot index (engine-assigned)
+    known: jnp.ndarray      # bool: slot had an existing key→slot mapping
+    hits: jnp.ndarray       # i64
+    limit: jnp.ndarray      # i64
+    duration: jnp.ndarray   # i64
+    algorithm: jnp.ndarray  # i32
+    behavior: jnp.ndarray   # i32 bitflags
+    created_at: jnp.ndarray  # i64 epoch ms
+    burst: jnp.ndarray      # i64
+    greg_exp: jnp.ndarray   # i64: host-resolved GregorianExpiration (0 if unused)
+    greg_dur: jnp.ndarray   # i64: host-resolved GregorianDuration (0 if unused)
+    valid: jnp.ndarray      # bool: padding mask
+
+    @classmethod
+    def zeros(cls, n: int) -> "ReqBatch":
+        return cls(
+            slot=jnp.zeros(n, I32),
+            known=jnp.zeros(n, jnp.bool_),
+            hits=jnp.zeros(n, I64),
+            limit=jnp.zeros(n, I64),
+            duration=jnp.zeros(n, I64),
+            algorithm=jnp.zeros(n, I32),
+            behavior=jnp.zeros(n, I32),
+            created_at=jnp.zeros(n, I64),
+            burst=jnp.zeros(n, I64),
+            greg_exp=jnp.zeros(n, I64),
+            greg_dur=jnp.zeros(n, I64),
+            valid=jnp.zeros(n, jnp.bool_),
+        )
+
+
+class RespBatch(NamedTuple):
+    """Per-request results (reference ``RateLimitResp``)."""
+
+    status: jnp.ndarray     # i32
+    limit: jnp.ndarray      # i64
+    remaining: jnp.ndarray  # i64
+    reset_time: jnp.ndarray  # i64
+    over_limit: jnp.ndarray  # bool: metricOverLimitCounter signal
+
+
+def _trunc_i64(x: jnp.ndarray) -> jnp.ndarray:
+    """float64 → int64 with C/Go truncation-toward-zero semantics."""
+    return x.astype(I64)
+
+
+def bucket_transition(
+    now: jnp.ndarray, s: BucketState, r: ReqBatch
+) -> tuple[BucketState, RespBatch]:
+    """Apply one batch of requests to their (gathered) bucket states.
+
+    Elementwise over the batch: ``s`` holds per-request gathers of the state
+    table, the returned state is scattered back by the engine.  Assumes at
+    most one request per slot (the engine's rank-rounds guarantee this).
+    """
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+
+    reset_b = (r.behavior & Behavior.RESET_REMAINING) != 0
+    drain_b = (r.behavior & Behavior.DRAIN_OVER_LIMIT) != 0
+    greg_b = (r.behavior & Behavior.DURATION_IS_GREGORIAN) != 0
+
+    # Cache-read existence: item present and not expired (cache.go:43-57,
+    # lrucache.go:111-128 treat now > ExpireAt as a miss + eviction).
+    exists = r.known & s.in_use & (now <= s.expire_at)
+    is_token = r.algorithm == jnp.int32(Algorithm.TOKEN_BUCKET)
+    algo_match = s.algorithm == r.algorithm
+
+    h = r.hits
+    # Guard against limit == 0 division (service-level validation rejects it;
+    # the kernel must still be total).
+    safe_limit_f = jnp.where(r.limit == 0, jnp.int64(1), r.limit).astype(F64)
+
+    # ------------------------------------------------------------------
+    # TOKEN BUCKET (algorithms.go:37-257)
+    # ------------------------------------------------------------------
+    # Branch T_RESET: RESET_REMAINING on an existing item removes it and
+    # reports a full bucket (algorithms.go:78-90). Checked before the
+    # algorithm-switch test, so it applies even if the stored item is leaky.
+    tok_reset = exists & reset_b
+
+    # Branch T_EXIST: normal existing token bucket.
+    tok_exist = exists & ~reset_b & algo_match
+
+    # Limit delta: remaining += newLimit - oldLimit, clamp ≥ 0 (:106-113).
+    t_rem0 = jnp.where(
+        s.limit != r.limit,
+        jnp.maximum(s.remaining + (r.limit - s.limit), 0),
+        s.remaining,
+    )
+    # Response snapshot taken *before* any duration-change renewal (:115-120).
+    rl_status = s.status
+    rl_rem_base = t_rem0
+    # Duration change (:123-147).
+    dur_changed = s.duration != r.duration
+    expire_cand = jnp.where(greg_b, r.greg_exp, s.created_at + r.duration)
+    renew = expire_cand <= r.created_at
+    expire_new = jnp.where(renew, r.created_at + r.duration, expire_cand)
+    t_created = jnp.where(dur_changed & renew, r.created_at, s.created_at)
+    t_rem1 = jnp.where(dur_changed & renew, r.limit, t_rem0)
+    t_expire = jnp.where(dur_changed, expire_new, s.expire_at)
+    rl_reset = jnp.where(dur_changed, expire_new, s.expire_at)
+
+    # Outcome precedence (:157-198): query > already-at-zero > exact
+    # remainder > over-ask > decrement.
+    t_query = h == 0
+    t_at_zero = ~t_query & (rl_rem_base == 0) & (h > 0)
+    t_exact = ~t_query & ~t_at_zero & (t_rem1 == h)
+    t_over = ~t_query & ~t_at_zero & ~t_exact & (h > t_rem1)
+    t_dec = ~t_query & ~t_at_zero & ~t_exact & ~t_over
+
+    te_rem = jnp.where(
+        t_exact,
+        jnp.int64(0),
+        jnp.where(
+            t_over,
+            jnp.where(drain_b, jnp.int64(0), t_rem1),
+            jnp.where(t_dec, t_rem1 - h, t_rem1),
+        ),
+    )
+    te_status = jnp.where(t_at_zero, OVER, s.status)
+    te_resp_status = jnp.where(t_at_zero | t_over, OVER, rl_status)
+    te_resp_rem = jnp.where(
+        t_exact,
+        jnp.int64(0),
+        jnp.where(
+            t_over,
+            jnp.where(drain_b, jnp.int64(0), rl_rem_base),
+            jnp.where(t_dec, t_rem1 - h, rl_rem_base),
+        ),
+    )
+
+    # Branch T_NEW: no usable item → tokenBucketNewItem (:206-257).
+    tn_expire = jnp.where(greg_b, r.greg_exp, r.created_at + r.duration)
+    tn_over = h > r.limit
+    tn_rem = jnp.where(tn_over, r.limit, r.limit - h)
+    tn_resp_status = jnp.where(tn_over, OVER, UNDER)
+
+    # ------------------------------------------------------------------
+    # LEAKY BUCKET (algorithms.go:260-493)
+    # ------------------------------------------------------------------
+    burst = jnp.where(r.burst == 0, r.limit, r.burst)  # default Burst=Limit (:264-266)
+
+    leak_exist = exists & algo_match  # for leaky requests; reset handled inline
+
+    # RESET_REMAINING refills to burst and *continues* (:320-322).
+    b_rem0 = jnp.where(reset_b, burst.astype(F64), s.remaining_f)
+    # Burst change (:325-330).
+    burst_changed = s.burst != burst
+    b_rem1 = jnp.where(
+        burst_changed & (burst > _trunc_i64(b_rem0)), burst.astype(F64), b_rem0
+    )
+    # Rate: ms per token. Gregorian uses the whole calendar interval (:336-354).
+    rate = jnp.where(greg_b, r.greg_dur.astype(F64), r.duration.astype(F64)) / safe_limit_f
+    duration_eff = jnp.where(greg_b, r.greg_exp - now, r.duration)
+    # Leak whole tokens only (:361-367), clamp to burst (:369-371).
+    elapsed = r.created_at - s.updated_at
+    leak = elapsed.astype(F64) / jnp.where(rate == 0, jnp.float64(1), rate)
+    leaked = _trunc_i64(leak) > 0
+    b_rem2 = jnp.where(leaked, b_rem1 + leak, b_rem1)
+    b_upd = jnp.where(leaked, r.created_at, s.updated_at)
+    b_rem3 = jnp.where(_trunc_i64(b_rem2) > burst, burst.astype(F64), b_rem2)
+
+    rem_i = _trunc_i64(b_rem3)
+    rate_i = _trunc_i64(rate)
+    # Outcome precedence (:389-430): at-zero > exact remainder > over-ask >
+    # query > decrement.  (Note: exact-remainder precedes the Hits==0 check.)
+    l_at_zero = (rem_i == 0) & (h > 0)
+    l_exact = ~l_at_zero & (rem_i == h)
+    l_over = ~l_at_zero & ~l_exact & (h > rem_i)
+    l_query = ~l_at_zero & ~l_exact & ~l_over & (h == 0)
+    l_dec = ~l_at_zero & ~l_exact & ~l_over & ~l_query
+
+    le_remf = jnp.where(
+        l_exact,
+        jnp.float64(0.0),
+        jnp.where(
+            l_over,
+            jnp.where(drain_b, jnp.float64(0.0), b_rem3),
+            jnp.where(l_dec, b_rem3 - h.astype(F64), b_rem3),
+        ),
+    )
+    le_resp_status = jnp.where(l_at_zero | l_over, OVER, UNDER)
+    le_resp_rem = jnp.where(
+        l_exact,
+        jnp.int64(0),
+        jnp.where(
+            l_over,
+            jnp.where(drain_b, jnp.int64(0), rem_i),
+            jnp.where(l_dec, _trunc_i64(b_rem3 - h.astype(F64)), rem_i),
+        ),
+    )
+    # Over-ask keeps the reset_time computed from the pre-drain remaining
+    # (the drain branch at :414-417 zeroes Remaining but not ResetTime).
+    le_reset_rem = jnp.where(l_over, rem_i, le_resp_rem)
+    le_resp_reset = r.created_at + (r.limit - le_reset_rem) * rate_i
+    # Hits != 0 bumps the cache expiration (:356-358).
+    le_expire = jnp.where(h != 0, r.created_at + duration_eff, s.expire_at)
+
+    # Leaky new item (:437-493). `rate` from the raw duration (quirk).
+    ln_rate_i = _trunc_i64(r.duration.astype(F64) / safe_limit_f)
+    ln_duration = jnp.where(greg_b, r.greg_exp - now, r.duration)
+    ln_over = h > burst
+    ln_remf = jnp.where(ln_over, jnp.float64(0.0), (burst - h).astype(F64))
+    ln_resp_rem = jnp.where(ln_over, jnp.int64(0), burst - h)
+    ln_resp_reset = r.created_at + (r.limit - ln_resp_rem) * ln_rate_i
+    ln_resp_status = jnp.where(ln_over, OVER, UNDER)
+    ln_expire = r.created_at + ln_duration
+
+    # ------------------------------------------------------------------
+    # Select per-request outcome
+    # ------------------------------------------------------------------
+    tok_new = is_token & ~tok_reset & ~tok_exist  # miss OR stored-algo mismatch
+    leak_new = ~is_token & ~leak_exist
+
+    def sel(tr, te, tn, le, ln):
+        """Select by branch: token-reset / token-exist / token-new /
+        leaky-exist / leaky-new."""
+        tok = jnp.where(tok_reset, tr, jnp.where(tok_exist, te, tn))
+        lk = jnp.where(leak_exist, le, ln)
+        return jnp.where(is_token, tok, lk)
+
+    zero64 = jnp.zeros_like(r.hits)
+    new_state = BucketState(
+        algorithm=jnp.where(is_token, jnp.int32(Algorithm.TOKEN_BUCKET),
+                            jnp.int32(Algorithm.LEAKY_BUCKET)),
+        limit=r.limit,
+        remaining=sel(zero64, te_rem, tn_rem, s.remaining, s.remaining),
+        remaining_f=sel(s.remaining_f * 0, s.remaining_f, s.remaining_f, le_remf, ln_remf),
+        duration=sel(zero64, r.duration, r.duration, r.duration, ln_duration),
+        created_at=sel(zero64, t_created, r.created_at, s.created_at, s.created_at),
+        updated_at=sel(zero64, s.updated_at, s.updated_at, b_upd, r.created_at),
+        burst=sel(zero64, s.burst, s.burst, burst, burst),
+        status=sel(jnp.zeros_like(s.status), te_status, UNDER, s.status, UNDER),
+        expire_at=sel(zero64, t_expire, tn_expire, le_expire, ln_expire),
+        in_use=sel(jnp.zeros_like(s.in_use), s.in_use | True, s.in_use | True,
+                   s.in_use | True, s.in_use | True),
+    )
+
+    resp = RespBatch(
+        status=sel(UNDER * jnp.ones_like(s.status), te_resp_status,
+                   tn_resp_status, le_resp_status, ln_resp_status),
+        limit=r.limit,
+        remaining=sel(r.limit, te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem),
+        reset_time=sel(zero64, rl_reset, tn_expire, le_resp_reset, ln_resp_reset),
+        over_limit=sel(
+            jnp.zeros_like(exists),
+            t_at_zero | t_over,
+            tn_over,
+            l_at_zero | l_over,
+            ln_over,
+        ),
+    )
+    return new_state, resp

@@ -1,0 +1,440 @@
+"""Tick-batched rate-limit engine: the TPU replacement for the worker pool.
+
+The reference shards its key space over N single-goroutine workers with
+private cache shards and routes each request through channels
+(``workers.go:19-37,125-147``).  Here the whole table is one device-resident
+struct-of-arrays (:class:`gubernator_tpu.ops.buckets.BucketState`) and a
+*tick* applies an entire batch of requests in one fused XLA program:
+
+    gather slots → branch-free transition → scatter back
+
+**Sequential semantics for duplicate keys.**  Go serializes same-key requests
+via worker ownership; a batch may contain several hits on one key and each
+must observe the state left by the previous one.  We reproduce this exactly:
+requests are ranked by arrival order *within* their slot (a stable sort by
+slot + a segmented iota), and a ``lax.while_loop`` applies one "rank round"
+at a time — round *k* touches at most one request per slot, so gathers and
+scatters never conflict.  Batches with all-unique keys run exactly one round.
+
+**Host/device split.**  The host owns the key→slot mapping (strings never
+reach the device), stamps wall-clock time, resolves Gregorian calendar math,
+and reclaims slots (TTL first, then LRU by last-touched tick — mirroring the
+expired-on-read eviction + evict-oldest of ``lrucache.go:88-149``).  The
+device owns all bucket arithmetic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gubernator_tpu.ops.buckets import (
+    BucketState,
+    ReqBatch,
+    RespBatch,
+    bucket_transition,
+)
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+from gubernator_tpu.utils import timeutil
+
+
+def _rank_within_slot(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
+    """Arrival rank of each request among requests sharing its slot.
+
+    Stable-sorts by slot (invalid rows pushed past ``capacity``), computes a
+    segmented iota over equal-slot runs, and scatters ranks back to request
+    order.  O(B log B), no table-sized buffers.
+    """
+    b = slot.shape[0]
+    sort_key = jnp.where(valid, slot, capacity).astype(jnp.int64)
+    order = jnp.argsort(sort_key, stable=True)
+    sorted_key = sort_key[order]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+    )
+    seg_start = lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, 0))
+    rank_sorted = idx - seg_start
+    rank = jnp.zeros(b, jnp.int32).at[order].set(rank_sorted)
+    return rank
+
+
+# Row layout of the packed request matrix (one H2D transfer per tick instead
+# of 12 — device-transfer latency dominates small ticks, especially over a
+# tunneled device).
+REQ_ROWS = (
+    "slot", "known", "hits", "limit", "duration", "algorithm", "behavior",
+    "created_at", "burst", "greg_exp", "greg_dur", "valid",
+)
+
+
+def unpack_reqs(packed: jnp.ndarray) -> ReqBatch:
+    """(12, B) int64 matrix → ReqBatch (device-side, inside jit)."""
+    f = dict(zip(REQ_ROWS, packed))
+    return ReqBatch(
+        slot=f["slot"].astype(jnp.int32),
+        known=f["known"].astype(jnp.bool_),
+        hits=f["hits"],
+        limit=f["limit"],
+        duration=f["duration"],
+        algorithm=f["algorithm"].astype(jnp.int32),
+        behavior=f["behavior"].astype(jnp.int32),
+        created_at=f["created_at"],
+        burst=f["burst"],
+        greg_exp=f["greg_exp"],
+        greg_dur=f["greg_dur"],
+        valid=f["valid"].astype(jnp.bool_),
+    )
+
+
+def pack_resp(resp: RespBatch) -> jnp.ndarray:
+    """RespBatch → (5, B) int64 matrix (one D2H transfer)."""
+    return jnp.stack(
+        [
+            resp.status.astype(jnp.int64),
+            resp.limit,
+            resp.remaining,
+            resp.reset_time,
+            resp.over_limit.astype(jnp.int64),
+        ]
+    )
+
+
+def make_tick_fn(capacity: int):
+    """Build the jittable tick: (state, reqs, now) → (state, responses).
+
+    Pure function of its inputs (no clocks, no host state) so the driver can
+    compile-check it and shard it.
+    """
+
+    def tick(state: BucketState, reqs: ReqBatch, now: jnp.ndarray):
+        b = reqs.slot.shape[0]
+        rank = _rank_within_slot(reqs.slot, reqs.valid, capacity)
+        n_rounds = jnp.max(jnp.where(reqs.valid, rank, 0)) + 1
+
+        resp0 = RespBatch(
+            status=jnp.zeros(b, jnp.int32),
+            limit=jnp.zeros(b, jnp.int64),
+            remaining=jnp.zeros(b, jnp.int64),
+            reset_time=jnp.zeros(b, jnp.int64),
+            over_limit=jnp.zeros(b, jnp.bool_),
+        )
+
+        def cond(carry):
+            k, _, _ = carry
+            return k < n_rounds
+
+        def body(carry):
+            k, st, resp = carry
+            active = reqs.valid & (rank == k)
+            gathered = jax.tree.map(lambda a: a[reqs.slot], st)
+            new_g, r_out = bucket_transition(now, gathered, reqs)
+            # Scatter only this round's rows; inactive rows aim out of
+            # bounds and are dropped.
+            scat = jnp.where(active, reqs.slot, capacity)
+            st = jax.tree.map(
+                lambda tbl, upd: tbl.at[scat].set(upd, mode="drop"), st, new_g
+            )
+            resp = jax.tree.map(
+                lambda old, new: jnp.where(active, new, old), resp, r_out
+            )
+            return k + 1, st, resp
+
+        _, state, resp = lax.while_loop(cond, body, (jnp.int32(0), state, resp0))
+        return state, resp
+
+    def tick_packed(state: BucketState, packed: jnp.ndarray, now: jnp.ndarray):
+        state, resp = tick(state, unpack_reqs(packed), now)
+        return state, pack_resp(resp)
+
+    tick_packed.unpacked = tick
+    return tick_packed
+
+
+def make_evict_fn():
+    """Jitted slot eviction: mark a batch of slots unused (LRU reclamation)."""
+
+    def evict(state: BucketState, slots: jnp.ndarray) -> BucketState:
+        return state._replace(
+            in_use=state.in_use.at[slots].set(False, mode="drop")
+        )
+
+    return evict
+
+
+class SlotMap:
+    """Host-side key→slot table (the stand-in for ``lrucache.go``'s map).
+
+    Python-dict based; the C++ native version (gubernator_tpu/native) slots in
+    behind the same interface for the 10M+ key regime.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._map: Dict[str, int] = {}
+        self._keys: List[Optional[str]] = [None] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def get(self, key: str) -> Optional[int]:
+        return self._map.get(key)
+
+    def assign(self, key: str) -> Optional[int]:
+        """Return the slot for key, allocating if new; None if table full."""
+        s = self._map.get(key)
+        if s is not None:
+            return s
+        if not self._free:
+            return None
+        s = self._free.pop()
+        self._map[key] = s
+        self._keys[s] = key
+        return s
+
+    def release(self, slot: int) -> None:
+        key = self._keys[slot]
+        if key is not None:
+            del self._map[key]
+            self._keys[slot] = None
+            self._free.append(slot)
+
+    def key_of(self, slot: int) -> Optional[str]:
+        return self._keys[slot]
+
+
+class TickEngine:
+    """Owns the device state table and applies request batches tick by tick.
+
+    Thread-safe: the service layer calls :meth:`process` from its tick loop;
+    loaders/metrics may snapshot concurrently.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 1 << 16,
+        max_batch: int = 4096,
+        device: Optional[jax.Device] = None,
+    ):
+        self.capacity = int(capacity)
+        self.max_batch = int(max_batch)
+        self.device = device or jax.devices()[0]
+        with jax.default_device(self.device):
+            self.state: BucketState = jax.tree.map(
+                jnp.asarray, BucketState.zeros(self.capacity)
+            )
+        self._tick = jax.jit(make_tick_fn(self.capacity), donate_argnums=(0,))
+        self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
+        self.slots = SlotMap(self.capacity)
+        self._last_access = np.zeros(self.capacity, np.int64)
+        # Slots assigned host-side but not yet written by a device tick; the
+        # device's in_use lags for these, so reclamation must not treat them
+        # as dead (or two live keys could share a slot within one tick).
+        self._pending: set = set()
+        self._tick_count = 0
+        self._lock = threading.RLock()
+        # Metrics mirrors (lrucache.go:48-59, gubernator.go:60-111 families).
+        self.metric_hits = 0
+        self.metric_misses = 0
+        self.metric_over_limit = 0
+        self.metric_unexpired_evictions = 0
+
+    # ------------------------------------------------------------------
+    # Host-side request preparation
+    # ------------------------------------------------------------------
+    def _resolve_slot(self, key: str, now: int) -> tuple[int, bool]:
+        known = self.slots.get(key) is not None
+        slot = self.slots.assign(key)
+        if slot is None:
+            self._reclaim(now)
+            slot = self.slots.assign(key)
+            if slot is None:
+                raise RuntimeError("rate-limit table full; eviction failed")
+        if not known:
+            self._pending.add(slot)
+        if known:
+            self.metric_hits += 1
+        else:
+            self.metric_misses += 1
+        return slot, known
+
+    def _reclaim(self, now: int, want: Optional[int] = None) -> None:
+        """Free expired slots; fall back to LRU eviction (lrucache.go:115-149)."""
+        want = want or max(1, self.capacity // 16)
+        in_use = np.asarray(self.state.in_use)
+        expire = np.asarray(self.state.expire_at)
+        mapped = np.array([k is not None for k in self.slots._keys])
+        # Slots assigned since the last tick look un-used on device; they are
+        # live, not dead.
+        if self._pending:
+            pend = np.fromiter(self._pending, np.int64)
+            mapped[pend] = False
+        dead = mapped & (~in_use | (expire < now))
+        freed = np.flatnonzero(dead)
+        for s in freed:
+            self.slots.release(int(s))
+        if len(freed) >= want:
+            return
+        # LRU: evict the least-recently-touched live slots.
+        live = np.flatnonzero(mapped & ~dead)
+        if len(live) == 0:
+            return
+        n = min(want - len(freed), len(live))
+        victims = live[np.argsort(self._last_access[live])[:n]]
+        self.metric_unexpired_evictions += int(n)
+        for s in victims:
+            self.slots.release(int(s))
+        self.state = self._evict(self.state, jnp.asarray(victims, jnp.int32))
+
+    def build_batch(
+        self, requests: Sequence[RateLimitRequest], now: int
+    ) -> tuple[np.ndarray, int]:
+        """Resolve keys to slots and pack the padded (12, B) request matrix.
+
+        A single int64 matrix means one H2D transfer per tick; per-transfer
+        latency dominates small ticks.
+        """
+        n = len(requests)
+        if n > self.max_batch:
+            raise ValueError(f"batch of {n} exceeds engine max {self.max_batch}")
+        b = self.max_batch
+        m = np.zeros((len(REQ_ROWS), b), np.int64)
+        row = {name: i for i, name in enumerate(REQ_ROWS)}
+        m[row["slot"]] = self.capacity  # padding rows scatter out of bounds
+        errors: Dict[int, str] = {}
+        for i, r in enumerate(requests):
+            # Per-request failures mark the row invalid and surface in
+            # RateLimitResponse.error (the reference's error-in-item, not
+            # RPC-failure convention, gubernator.go:208-216).
+            if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+                try:
+                    m[row["greg_exp"], i] = timeutil.gregorian_expiration(now, r.duration)
+                    m[row["greg_dur"], i] = timeutil.gregorian_duration(now, r.duration)
+                except timeutil.GregorianError as e:
+                    errors[i] = str(e)
+                    continue
+            key = r.hash_key()
+            slot, known = self._resolve_slot(key, now)
+            self._last_access[slot] = self._tick_count
+            m[row["slot"], i] = slot
+            m[row["known"], i] = known
+            m[row["hits"], i] = r.hits
+            m[row["limit"], i] = r.limit
+            m[row["duration"], i] = r.duration
+            m[row["algorithm"], i] = int(r.algorithm)
+            m[row["behavior"], i] = int(r.behavior)
+            m[row["created_at"], i] = r.created_at if r.created_at is not None else now
+            m[row["burst"], i] = r.burst
+            m[row["valid"], i] = 1
+        return m, n, errors
+
+    # ------------------------------------------------------------------
+    # The tick
+    # ------------------------------------------------------------------
+    def process(
+        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
+    ) -> List[RateLimitResponse]:
+        """Apply a batch of requests; returns responses in request order."""
+        if not requests:
+            return []
+        out: List[RateLimitResponse] = []
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            for chunk_start in range(0, len(requests), self.max_batch):
+                chunk = requests[chunk_start : chunk_start + self.max_batch]
+                packed, n, errors = self.build_batch(chunk, now)
+                self._tick_count += 1
+                self.state, resp = self._tick(
+                    self.state, jnp.asarray(packed), jnp.int64(now)
+                )
+                self._pending.clear()
+                rm = np.asarray(resp)  # one D2H: (5, B) int64
+                status, limit, remaining, reset, over = rm[:, :n]
+                self.metric_over_limit += int(over.sum())
+                out.extend(
+                    RateLimitResponse(error=errors[i])
+                    if i in errors
+                    else RateLimitResponse(
+                        status=int(status[i]),
+                        limit=int(limit[i]),
+                        remaining=int(remaining[i]),
+                        reset_time=int(reset[i]),
+                    )
+                    for i in range(n)
+                )
+        return out
+
+    # ------------------------------------------------------------------
+    # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
+    # ------------------------------------------------------------------
+    def export_items(self) -> List[dict]:
+        """Drain live bucket state to host dicts (Loader.Save analog)."""
+        with self._lock:
+            st = jax.tree.map(np.asarray, self.state)
+            items = []
+            for slot in range(self.capacity):
+                key = self.slots.key_of(slot)
+                if key is None or not st.in_use[slot]:
+                    continue
+                items.append(
+                    {
+                        "key": key,
+                        "algorithm": int(st.algorithm[slot]),
+                        "limit": int(st.limit[slot]),
+                        "remaining": int(st.remaining[slot]),
+                        "remaining_f": float(st.remaining_f[slot]),
+                        "duration": int(st.duration[slot]),
+                        "created_at": int(st.created_at[slot]),
+                        "updated_at": int(st.updated_at[slot]),
+                        "burst": int(st.burst[slot]),
+                        "status": int(st.status[slot]),
+                        "expire_at": int(st.expire_at[slot]),
+                    }
+                )
+            return items
+
+    def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
+        """Install snapshot items into the table (Loader.Load analog).
+
+        Reclaims space up front and assigns slots directly (no device
+        eviction mid-loop), then writes the whole table once — so a partial
+        snapshot of the device state can't clobber concurrent updates.
+        """
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            live = [it for it in items if it["expire_at"] >= now]
+            if len(self.slots) + len(live) > self.capacity:
+                self._reclaim(now, want=len(live))
+            st = jax.tree.map(np.array, self.state)
+            for it in live:
+                slot = self.slots.assign(it["key"])
+                if slot is None:
+                    break  # table full even after reclaim; drop the tail
+                self._last_access[slot] = self._tick_count
+                st.algorithm[slot] = it["algorithm"]
+                st.limit[slot] = it["limit"]
+                st.remaining[slot] = it["remaining"]
+                st.remaining_f[slot] = it["remaining_f"]
+                st.duration[slot] = it["duration"]
+                st.created_at[slot] = it["created_at"]
+                st.updated_at[slot] = it["updated_at"]
+                st.burst[slot] = it["burst"]
+                st.status[slot] = it["status"]
+                st.expire_at[slot] = it["expire_at"]
+                st.in_use[slot] = True
+            with jax.default_device(self.device):
+                self.state = jax.tree.map(jnp.asarray, st)
+
+    def cache_size(self) -> int:
+        return len(self.slots)

@@ -1,0 +1,29 @@
+"""Test harness config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's trick of testing "multi-node" behavior in one
+process (cluster/cluster.go): we test multi-chip sharding on virtual CPU
+devices. Must run before jax initializes.
+
+The environment injects a tunneled-TPU PJRT plugin via PYTHONPATH
+(.axon_site) whose registration can block on the tunnel even when
+JAX_PLATFORMS=cpu — strip it so tests are hermetic and never depend on
+tunnel health.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

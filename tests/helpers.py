@@ -1,0 +1,29 @@
+"""Shared test helpers: a TickEngine wrapper with a controllable clock.
+
+Plays the role of the reference's `clock.Freeze/Advance` (holster clock)
+used throughout functional_test.go.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from gubernator_tpu.ops.engine import TickEngine
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+
+class Sim:
+    """Single-node engine with frozen, manually-advanced time."""
+
+    def __init__(self, capacity: int = 1024, max_batch: int = 64, now: int = 1_700_000_000_000):
+        self.engine = TickEngine(capacity=capacity, max_batch=max_batch)
+        self.now = now
+
+    def advance(self, ms: int) -> None:
+        self.now += ms
+
+    def hit(self, **kw) -> RateLimitResponse:
+        return self.batch([RateLimitRequest(**kw)])[0]
+
+    def batch(self, reqs: List[RateLimitRequest]) -> List[RateLimitResponse]:
+        return self.engine.process(reqs, now=self.now)

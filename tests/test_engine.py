@@ -1,0 +1,120 @@
+"""Tick-engine tests: duplicate-key sequencing, ordering, eviction, batching.
+
+These cover what the reference gets from worker-pool serialization
+(workers.go:19-37) and LRU eviction (lrucache.go:88-149).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import TickEngine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+from tests.helpers import Sim
+
+
+def req(key="k", hits=1, limit=10, duration=60_000, **kw):
+    return RateLimitRequest(
+        name="t", unique_key=key, hits=hits, limit=limit, duration=duration,
+        algorithm=kw.pop("algorithm", Algorithm.TOKEN_BUCKET), **kw,
+    )
+
+
+def test_duplicate_keys_sequential_semantics():
+    # Same key three times in one batch must behave like three sequential
+    # requests (Go serializes per key via worker ownership).
+    s = Sim()
+    rs = s.batch([req(hits=4), req(hits=4), req(hits=4)])
+    assert [r.remaining for r in rs] == [6, 2, 2]
+    assert [r.status for r in rs] == [
+        Status.UNDER_LIMIT, Status.UNDER_LIMIT, Status.OVER_LIMIT,
+    ]
+
+
+def test_duplicate_keys_exhaust_exactly():
+    s = Sim()
+    rs = s.batch([req(hits=1, limit=3) for _ in range(5)])
+    assert [r.remaining for r in rs] == [2, 1, 0, 0, 0]
+    assert [r.status for r in rs] == [
+        Status.UNDER_LIMIT, Status.UNDER_LIMIT, Status.UNDER_LIMIT,
+        Status.OVER_LIMIT, Status.OVER_LIMIT,
+    ]
+
+
+def test_mixed_keys_order_preserved():
+    s = Sim()
+    reqs = [req(key=f"k{i % 3}", hits=1, limit=100) for i in range(9)]
+    rs = s.batch(reqs)
+    # Each of k0,k1,k2 is hit 3 times; per-key remaining descends 99,98,97.
+    for i in range(9):
+        assert rs[i].remaining == 99 - i // 3
+
+
+def test_duplicate_new_key_in_one_batch():
+    # First occurrence creates the bucket; later ones must see it.
+    s = Sim()
+    rs = s.batch([req(key="fresh", hits=10), req(key="fresh", hits=1)])
+    assert rs[0].remaining == 0
+    assert rs[0].status == Status.UNDER_LIMIT
+    assert rs[1].status == Status.OVER_LIMIT
+
+
+def test_reset_remaining_then_hit_same_batch():
+    s = Sim()
+    s.batch([req(hits=10)])
+    rs = s.batch([
+        req(hits=0, behavior=Behavior.RESET_REMAINING),
+        req(hits=3),
+    ])
+    assert rs[0].remaining == 10
+    # Reset removed the item; second request creates a fresh bucket.
+    assert rs[1].remaining == 7
+
+
+def test_chunking_beyond_max_batch():
+    s = Sim(capacity=2048, max_batch=32)
+    reqs = [req(key=f"k{i}", hits=1, limit=5) for i in range(100)]
+    rs = s.batch(reqs)
+    assert len(rs) == 100
+    assert all(r.remaining == 4 for r in rs)
+
+
+def test_eviction_reclaims_expired():
+    s = Sim(capacity=8, max_batch=8)
+    for i in range(8):
+        s.batch([req(key=f"k{i}", duration=100)])
+    s.advance(200)  # all expired
+    rs = s.batch([req(key="new0", duration=100)])
+    assert rs[0].remaining == 9
+    assert s.engine.cache_size() <= 8
+
+
+def test_eviction_lru_when_nothing_expired():
+    s = Sim(capacity=8, max_batch=8)
+    for i in range(8):
+        s.batch([req(key=f"k{i}", duration=600_000)])
+    rs = s.batch([req(key="overflow", duration=600_000)])
+    assert rs[0].remaining == 9
+    assert s.engine.metric_unexpired_evictions > 0
+
+
+def test_snapshot_roundtrip():
+    # Loader.Save/Load analog (workers.go:329-534).
+    s = Sim()
+    s.batch([req(key="a", hits=3), req(key="b", hits=7)])
+    items = s.engine.export_items()
+    assert len(items) == 2
+
+    s2 = Sim()
+    s2.engine.load_items(items, now=s2.now)
+    rs = s2.batch([req(key="a", hits=0), req(key="b", hits=0)])
+    assert rs[0].remaining == 7
+    assert rs[1].remaining == 3
+
+
+def test_empty_batch():
+    s = Sim()
+    assert s.batch([]) == []
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

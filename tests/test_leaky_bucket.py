@@ -1,0 +1,140 @@
+"""Golden behavior tests for the leaky-bucket kernel.
+
+Ported from the reference behavioral spec (functional_test.go:477-900,
+algorithms.go:260-493): limit 10 per 30s → leak rate 3s/token.
+"""
+
+import pytest
+
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+from tests.helpers import Sim
+
+
+def leaky(name="l", key="k", hits=1, limit=10, duration=30_000, **kw):
+    return dict(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=duration,
+        algorithm=Algorithm.LEAKY_BUCKET, **kw,
+    )
+
+
+def test_leaky_bucket_sequence():
+    # functional_test.go:477 TestLeakyBucket, verbatim sequence.
+    s = Sim()
+    seq = [
+        # (hits, expected_remaining, expected_status, sleep_ms_after)
+        (1, 9, Status.UNDER_LIMIT, 1000),
+        (1, 8, Status.UNDER_LIMIT, 1000),
+        (1, 7, Status.UNDER_LIMIT, 1500),
+        (0, 8, Status.UNDER_LIMIT, 3000),   # leaked one 3.5s after first hit
+        (0, 9, Status.UNDER_LIMIT, 0),      # another leak 3s later
+        (9, 0, Status.UNDER_LIMIT, 0),      # max out
+        (1, 0, Status.OVER_LIMIT, 3000),
+        (0, 1, Status.UNDER_LIMIT, 60_000),  # leaked 1
+        (0, 10, Status.UNDER_LIMIT, 60_000),  # clamped at burst=limit
+        (10, 0, Status.UNDER_LIMIT, 29_000),
+        (9, 0, Status.UNDER_LIMIT, 3000),
+        (1, 0, Status.UNDER_LIMIT, 1000),
+    ]
+    for i, (hits, remaining, status, sleep) in enumerate(seq):
+        r = s.hit(**leaky(hits=hits))
+        assert (r.status, r.remaining) == (status, remaining), f"step {i}"
+        assert r.limit == 10
+        # ResetTime invariant from the reference test: now + (limit-remaining)*rate
+        assert r.reset_time == s.now + (10 - r.remaining) * 3000, f"step {i}"
+        s.advance(sleep)
+
+
+def test_leaky_bucket_with_burst():
+    # functional_test.go:604 TestLeakyBucketWithBurst: burst=20, limit=10/30s.
+    s = Sim()
+    seq = [
+        (1, 19, Status.UNDER_LIMIT, 1000),
+        (1, 18, Status.UNDER_LIMIT, 1000),
+        (1, 17, Status.UNDER_LIMIT, 1500),
+        (0, 18, Status.UNDER_LIMIT, 3000),
+        (0, 19, Status.UNDER_LIMIT, 0),
+        (19, 0, Status.UNDER_LIMIT, 0),
+        (1, 0, Status.OVER_LIMIT, 3000),
+    ]
+    for i, (hits, remaining, status, sleep) in enumerate(seq):
+        r = s.hit(**leaky(hits=hits, burst=20))
+        assert (r.status, r.remaining) == (status, remaining), f"step {i}"
+        s.advance(sleep)
+
+
+def test_leaky_bucket_negative_hits():
+    # functional_test.go:781 TestLeakyBucketNegativeHits.
+    s = Sim()
+    r = s.hit(**leaky(hits=1))
+    assert r.remaining == 9
+    r = s.hit(**leaky(hits=-1))
+    assert r.remaining == 10
+    assert r.status == Status.UNDER_LIMIT
+
+
+def test_leaky_bucket_over_ask_no_drain():
+    s = Sim()
+    r = s.hit(**leaky(hits=1))
+    assert r.remaining == 9
+    r = s.hit(**leaky(hits=100))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 9)
+    r = s.hit(**leaky(hits=9))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+
+
+def test_leaky_bucket_drain_over_limit():
+    s = Sim()
+    r = s.hit(**leaky(hits=1, behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert r.remaining == 9
+    r = s.hit(**leaky(hits=100, behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    r = s.hit(**leaky(hits=1, behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+
+
+def test_leaky_bucket_first_request_over_burst():
+    # algorithms.go:468-477: Hits > Burst on a new bucket → OVER, remaining 0.
+    s = Sim()
+    r = s.hit(**leaky(hits=100))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+
+
+def test_leaky_bucket_reset_remaining():
+    # algorithms.go:320-322: RESET_REMAINING refills to burst and continues.
+    s = Sim()
+    r = s.hit(**leaky(hits=10))
+    assert r.remaining == 0
+    r = s.hit(**leaky(hits=1, behavior=Behavior.RESET_REMAINING))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 9)
+
+
+def test_leaky_bucket_division_regression():
+    # functional_test.go:1535 TestLeakyBucketDivBug regression: limit 2000
+    # per 30s; one hit then a query must report 1999, not garbage.
+    s = Sim()
+    r = s.hit(**leaky(hits=1, limit=2000, duration=30_000))
+    assert r.remaining == 1999
+    r = s.hit(**leaky(hits=0, limit=2000, duration=30_000))
+    assert r.remaining == 1999
+    assert r.limit == 2000
+
+
+def test_leaky_bucket_burst_change_refills():
+    # algorithms.go:325-330: raising burst above current remaining refills.
+    s = Sim()
+    r = s.hit(**leaky(hits=8))
+    assert r.remaining == 2
+    r = s.hit(**leaky(hits=1, burst=50))
+    assert r.remaining == 49
+
+
+def test_leaky_bucket_expiry_creates_fresh():
+    s = Sim()
+    s.hit(**leaky(hits=10))
+    s.advance(31_000)  # past duration; item expired (expire bump was at hit)
+    r = s.hit(**leaky(hits=1))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 9)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

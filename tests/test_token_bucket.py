@@ -1,0 +1,214 @@
+"""Golden behavior tests for the token-bucket kernel.
+
+Ported from the reference behavioral spec (functional_test.go:160-470 and
+algorithms.go:37-257): same sequences, same expected status/remaining, with
+time driven explicitly instead of clock.Freeze/Advance.
+"""
+
+import pytest
+
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+from tests.helpers import Sim
+
+
+def tok(name="t", key="k", hits=1, limit=2, duration=5, **kw):
+    kw.setdefault("algorithm", Algorithm.TOKEN_BUCKET)
+    return dict(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=duration, **kw,
+    )
+
+
+def test_token_bucket_basic():
+    # functional_test.go:160 TestTokenBucket: limit=2, duration=5ms.
+    s = Sim()
+    r = s.hit(**tok())
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 1, 2)
+    assert r.reset_time == s.now + 5
+
+    r = s.hit(**tok())
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+
+    s.advance(100)  # past the 5ms window -> fresh bucket
+    r = s.hit(**tok())
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+def test_token_bucket_over_limit_then_status_persisted():
+    s = Sim()
+    assert s.hit(**tok(limit=1)).remaining == 0
+    r = s.hit(**tok(limit=1))
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+    # Status is persisted into the bucket (algorithms.go:162-169): a Hits=0
+    # query now reports OVER_LIMIT.
+    r = s.hit(**tok(limit=1, hits=0))
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_token_bucket_negative_hits():
+    # functional_test.go:296 TestTokenBucketNegativeHits: negative hits add
+    # tokens, even beyond the limit.
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=1))
+    assert r.remaining == 9
+    r = s.hit(**tok(limit=10, duration=60000, hits=-1))
+    assert r.remaining == 10
+    r = s.hit(**tok(limit=10, duration=60000, hits=-5))
+    assert r.remaining == 15
+    assert r.status == Status.UNDER_LIMIT
+
+
+def test_token_bucket_over_ask_does_not_drain():
+    # algorithms.go:29-34 note + functional_test.go:434 over-ask semantics:
+    # asking more than remaining rejects but leaves the bucket intact.
+    s = Sim()
+    r = s.hit(**tok(limit=100, duration=60000, hits=1))
+    assert r.remaining == 99
+    r = s.hit(**tok(limit=100, duration=60000, hits=1000))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 99)
+    r = s.hit(**tok(limit=100, duration=60000, hits=99))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+
+
+def test_token_bucket_drain_over_limit():
+    # functional_test.go:368 TestDrainOverLimit: first over-limit event
+    # drains remaining to zero.
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=1,
+                    behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert r.remaining == 9
+    r = s.hit(**tok(limit=10, duration=60000, hits=100,
+                    behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    r = s.hit(**tok(limit=10, duration=60000, hits=1,
+                    behavior=Behavior.DRAIN_OVER_LIMIT))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+
+
+def test_token_bucket_first_request_over_limit():
+    # algorithms.go:240-248: Hits > Limit on a brand-new bucket returns
+    # OVER_LIMIT but remaining stays at Limit.
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=100))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 10)
+    r = s.hit(**tok(limit=10, duration=60000, hits=5))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 5)
+
+
+def test_token_bucket_exact_remainder():
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=10))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+    r = s.hit(**tok(limit=10, duration=60000, hits=1))
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_token_bucket_limit_change():
+    # functional_test.go:1343 TestChangeLimit: remaining adjusts by the
+    # limit delta (algorithms.go:106-113).
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=3))
+    assert r.remaining == 7
+    r = s.hit(**tok(limit=20, duration=60000, hits=0))
+    assert (r.limit, r.remaining) == (20, 17)
+    r = s.hit(**tok(limit=5, duration=60000, hits=0))
+    # 17 + (5-20) = 2
+    assert (r.limit, r.remaining) == (5, 2)
+    r = s.hit(**tok(limit=1, duration=60000, hits=0))
+    # 2 + (1-5) = -2 -> clamp 0
+    assert (r.limit, r.remaining) == (1, 0)
+
+
+def test_token_bucket_duration_change_extends_reset():
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=1000, hits=1))
+    created = s.now
+    assert r.reset_time == created + 1000
+    s.advance(500)
+    r = s.hit(**tok(limit=10, duration=60000, hits=1))
+    # expire recomputed from original CreatedAt (algorithms.go:126)
+    assert r.reset_time == created + 60000
+    assert r.remaining == 8
+
+
+def test_token_bucket_duration_change_renews_expired():
+    # algorithms.go:134-142: new duration that leaves the bucket already
+    # expired renews it: CreatedAt=now, Remaining=Limit... but the
+    # *response* remaining reflects the pre-renewal snapshot (quirk).
+    s = Sim()
+    s.hit(**tok(limit=10, duration=100000, hits=4))
+    s.advance(5000)
+    r = s.hit(**tok(limit=10, duration=1000, hits=1))
+    # expire = created + 1000 = now - 4000 <= now -> renew
+    assert r.reset_time == s.now + 1000
+    assert r.remaining == 9  # refilled to 10 by the renewal, then -1 hit
+    r = s.hit(**tok(limit=10, duration=1000, hits=0))
+    assert r.remaining == 9
+
+
+def test_token_bucket_reset_remaining():
+    # functional_test.go:1438 TestResetRemaining.
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=10))
+    assert r.remaining == 0
+    r = s.hit(**tok(limit=10, duration=60000, hits=0,
+                    behavior=Behavior.RESET_REMAINING))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 10)
+    assert r.reset_time == 0
+    r = s.hit(**tok(limit=10, duration=60000, hits=3))
+    assert r.remaining == 7
+
+
+def test_token_bucket_hits_zero_query_creates_item():
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=0))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 10)
+    r = s.hit(**tok(limit=10, duration=60000, hits=0))
+    assert r.remaining == 10
+
+
+def test_token_bucket_algorithm_switch_resets():
+    # algorithms.go:92-103: switching algorithms resets hit counts.
+    s = Sim()
+    r = s.hit(**tok(limit=10, duration=60000, hits=4))
+    assert r.remaining == 6
+    r = s.hit(**tok(limit=10, duration=60000, hits=1,
+                    algorithm=Algorithm.LEAKY_BUCKET))
+    assert r.remaining == 9  # fresh leaky bucket
+    r = s.hit(**tok(limit=10, duration=60000, hits=1,
+                    algorithm=Algorithm.TOKEN_BUCKET))
+    assert r.remaining == 9  # fresh token bucket again
+
+
+def test_token_bucket_expire_resets():
+    s = Sim()
+    s.hit(**tok(limit=2, duration=100, hits=2))
+    s.advance(101)
+    r = s.hit(**tok(limit=2, duration=100, hits=1))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+def test_token_bucket_gregorian_minutes():
+    # functional_test.go:221 TestTokenBucketGregorian, limit 60/minute.
+    from gubernator_tpu.utils.timeutil import gregorian_expiration
+    from gubernator_tpu.types import GREGORIAN_MINUTES
+
+    s = Sim()
+    g = dict(limit=60, duration=GREGORIAN_MINUTES,
+             behavior=Behavior.DURATION_IS_GREGORIAN)
+    r = s.hit(**tok(hits=1, **g))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 59)
+    assert r.reset_time == gregorian_expiration(s.now, GREGORIAN_MINUTES)
+    r = s.hit(**tok(hits=1, **g))
+    assert r.remaining == 58
+    r = s.hit(**tok(hits=58, **g))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+    r = s.hit(**tok(hits=1, **g))
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    s.advance(61_000)
+    r = s.hit(**tok(hits=0, **g))
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 60)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
