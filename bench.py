@@ -1,0 +1,76 @@
+"""Headline benchmark: rate-limit decisions/sec on one chip.
+
+Measures the steady-state throughput of the tick kernel — the fused
+gather → bucket-transition → scatter program that replaces the reference's
+per-key worker dispatch (``workers.go:190-324``, ``algorithms.go:37-493``).
+
+Prints ONE JSON line.  ``vs_baseline`` is measured against the
+BASELINE.json target of 50M decisions/sec/chip (the reference itself
+publishes only ~2,000 req/s/node from production prose — see BASELINE.md —
+so the engineered target is the honest denominator).
+"""
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+TARGET = 50_000_000.0
+
+
+def main():
+    from gubernator_tpu.ops.buckets import BucketState
+    from gubernator_tpu.ops.engine import REQ_ROWS, REQ_ROW_INDEX as rows, make_tick_fn
+
+    capacity = 1 << 20  # 1M slots resident in HBM
+    batch = 1 << 15     # 32768 decisions per tick
+    now = 1_700_000_000_000
+
+    rng = np.random.default_rng(0)
+    m = np.zeros((len(REQ_ROWS), batch), np.int64)
+    # Unique slots per tick (the common case; duplicate keys take extra
+    # rank-rounds and are exercised by the ladder configs instead).
+    m[rows["slot"]] = rng.permutation(capacity)[:batch]
+    m[rows["known"]] = 1
+    m[rows["hits"]] = 1
+    m[rows["limit"]] = 1_000_000
+    m[rows["duration"]] = 3_600_000
+    m[rows["algorithm"]] = rng.integers(0, 2, batch)  # mixed token+leaky
+    m[rows["created_at"]] = now
+    m[rows["valid"]] = 1
+
+    tick = jax.jit(make_tick_fn(capacity), donate_argnums=(0,))
+    state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
+    packed = jnp.asarray(m)
+
+    # Warm up / compile.
+    state, resp = tick(state, packed, jnp.int64(now))
+    jax.block_until_ready(resp)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, resp = tick(state, packed, jnp.int64(now + i))
+    jax.block_until_ready(resp)
+    dt = time.perf_counter() - t0
+
+    decisions_per_sec = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "rate_limit_decisions_per_sec_per_chip",
+                "value": round(decisions_per_sec, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(decisions_per_sec / TARGET, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,50 @@ REQ_ROWS = (
     "slot", "known", "hits", "limit", "duration", "algorithm", "behavior",
     "created_at", "burst", "greg_exp", "greg_dur", "valid",
 )
+REQ_ROW_INDEX = {name: i for i, name in enumerate(REQ_ROWS)}
+
+
+def resolve_gregorian(r: "RateLimitRequest", now: int) -> tuple[int, int]:
+    """Host-side Gregorian resolution for one request: (greg_exp, greg_dur).
+
+    Returns (0, 0) when DURATION_IS_GREGORIAN is unset; raises
+    :class:`gubernator_tpu.utils.timeutil.GregorianError` on a bad selector
+    (callers surface it in the per-item ``error`` field, the reference's
+    error-in-item convention, gubernator.go:208-216).
+    """
+    if not has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+        return 0, 0
+    return (
+        timeutil.gregorian_expiration(now, r.duration),
+        timeutil.gregorian_duration(now, r.duration),
+    )
+
+
+def pack_request_col(
+    m: np.ndarray,
+    col: int,
+    r: "RateLimitRequest",
+    *,
+    slot: int,
+    known: bool,
+    now: int,
+    greg_exp: int = 0,
+    greg_dur: int = 0,
+) -> None:
+    """Write one request into column ``col`` of a (len(REQ_ROWS), B) matrix."""
+    R = REQ_ROW_INDEX
+    m[R["slot"], col] = slot
+    m[R["known"], col] = known
+    m[R["hits"], col] = r.hits
+    m[R["limit"], col] = r.limit
+    m[R["duration"], col] = r.duration
+    m[R["algorithm"], col] = int(r.algorithm)
+    m[R["behavior"], col] = int(r.behavior)
+    m[R["created_at"], col] = r.created_at if r.created_at is not None else now
+    m[R["burst"], col] = r.burst
+    m[R["greg_exp"], col] = greg_exp
+    m[R["greg_dur"], col] = greg_dur
+    m[R["valid"], col] = 1
 
 
 def unpack_reqs(packed: jnp.ndarray) -> ReqBatch:
@@ -280,6 +324,9 @@ class TickEngine:
         if self._pending:
             pend = np.fromiter(self._pending, np.int64)
             mapped[pend] = False
+        # Slots already touched this tick (refreshed known keys) may look
+        # expired on device until the tick lands — they are live too.
+        mapped &= self._last_access != self._tick_count
         dead = mapped & (~in_use | (expire < now))
         freed = np.flatnonzero(dead)
         for s in freed:
@@ -310,33 +357,21 @@ class TickEngine:
             raise ValueError(f"batch of {n} exceeds engine max {self.max_batch}")
         b = self.max_batch
         m = np.zeros((len(REQ_ROWS), b), np.int64)
-        row = {name: i for i, name in enumerate(REQ_ROWS)}
-        m[row["slot"]] = self.capacity  # padding rows scatter out of bounds
+        m[REQ_ROW_INDEX["slot"]] = self.capacity  # padding scatters out of bounds
         errors: Dict[int, str] = {}
         for i, r in enumerate(requests):
-            # Per-request failures mark the row invalid and surface in
-            # RateLimitResponse.error (the reference's error-in-item, not
-            # RPC-failure convention, gubernator.go:208-216).
-            if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
-                try:
-                    m[row["greg_exp"], i] = timeutil.gregorian_expiration(now, r.duration)
-                    m[row["greg_dur"], i] = timeutil.gregorian_duration(now, r.duration)
-                except timeutil.GregorianError as e:
-                    errors[i] = str(e)
-                    continue
+            try:
+                greg_exp, greg_dur = resolve_gregorian(r, now)
+            except timeutil.GregorianError as e:
+                errors[i] = str(e)
+                continue
             key = r.hash_key()
             slot, known = self._resolve_slot(key, now)
             self._last_access[slot] = self._tick_count
-            m[row["slot"], i] = slot
-            m[row["known"], i] = known
-            m[row["hits"], i] = r.hits
-            m[row["limit"], i] = r.limit
-            m[row["duration"], i] = r.duration
-            m[row["algorithm"], i] = int(r.algorithm)
-            m[row["behavior"], i] = int(r.behavior)
-            m[row["created_at"], i] = r.created_at if r.created_at is not None else now
-            m[row["burst"], i] = r.burst
-            m[row["valid"], i] = 1
+            pack_request_col(
+                m, i, r, slot=slot, known=known, now=now,
+                greg_exp=greg_exp, greg_dur=greg_dur,
+            )
         return m, n, errors
 
     # ------------------------------------------------------------------
@@ -353,8 +388,8 @@ class TickEngine:
             now = now if now is not None else timeutil.now_ms()
             for chunk_start in range(0, len(requests), self.max_batch):
                 chunk = requests[chunk_start : chunk_start + self.max_batch]
-                packed, n, errors = self.build_batch(chunk, now)
                 self._tick_count += 1
+                packed, n, errors = self.build_batch(chunk, now)
                 self.state, resp = self._tick(
                     self.state, jnp.asarray(packed), jnp.int64(now)
                 )

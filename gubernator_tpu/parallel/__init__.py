@@ -1,0 +1,7 @@
+from gubernator_tpu.parallel.mesh_engine import (
+    MeshTickEngine,
+    make_mesh,
+    make_sharded_tick_fn,
+)
+
+__all__ = ["MeshTickEngine", "make_mesh", "make_sharded_tick_fn"]

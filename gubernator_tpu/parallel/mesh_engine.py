@@ -1,0 +1,277 @@
+"""Multi-chip sharded tick engine: the bucket table over a TPU mesh.
+
+The reference scales *within* a node by statically partitioning the key
+space over N lock-free workers (``workers.go:19-37,125-147``) and *across*
+nodes by consistent-hash ownership.  On TPU the intra-node story becomes a
+table **sharded over the device mesh**: a 1-D ``Mesh(('shard',))`` where
+device *d* owns the contiguous slot range ``[d*local_cap, (d+1)*local_cap)``.
+
+The hot path is deliberately collective-free: the host resolves each key to
+a global slot, routes it to the owning shard, and packs one request block
+per shard — ``(n_shards, ROWS, B)`` — so a tick under ``shard_map`` is pure
+data-parallel SPMD: every device gathers/updates only its own shard.  This
+mirrors the reference's "no mutexes, keys statically routed to workers"
+design, with devices in place of goroutines.  Collectives (``psum`` etc.)
+enter only on the GLOBAL-behavior reconciliation path (landing with the
+GLOBAL manager), matching how the reference keeps its hot loop local and
+reconciles asynchronously (``global.go``).
+
+Why not route on-device (all-to-all)?  Keys are strings; hashing and the
+key→slot map live on the host anyway (SURVEY.md §7 "Host/device split"), so
+the host already knows every request's shard — an on-device shuffle would
+add an all-to-all for nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.ops.buckets import BucketState
+from gubernator_tpu.ops.engine import (
+    REQ_ROWS,
+    REQ_ROW_INDEX,
+    make_evict_fn,
+    make_tick_fn,
+    pack_request_col,
+    resolve_gregorian,
+)
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+from gubernator_tpu.utils import timeutil
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D device mesh over the 'shard' axis (the slot-partition axis)."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), ("shard",))
+
+
+def make_sharded_tick_fn(mesh: Mesh, local_capacity: int):
+    """Build the sharded tick: (state, reqs, now) → (state, responses).
+
+    ``state`` arrays are length ``n_shards * local_capacity``, sharded along
+    axis 0; ``reqs`` is ``(n_shards, len(REQ_ROWS), B)`` with block *d*
+    holding requests whose **local** slot ids target shard *d* (padding rows
+    carry slot == local_capacity and valid == 0).  Responses come back as
+    ``(n_shards, 5, B)``; the host reassembles request order.
+    """
+    local_tick = make_tick_fn(local_capacity)
+
+    def _local(state_blk: BucketState, req_blk: jnp.ndarray, now: jnp.ndarray):
+        new_state, resp = local_tick(state_blk, req_blk[0], now)
+        return new_state, resp[None]
+
+    state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
+    return shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(state_spec, P("shard", None, None), P()),
+        out_specs=(state_spec, P("shard", None, None)),
+        check_vma=False,
+    )
+
+
+class MeshTickEngine:
+    """Host driver for the sharded table (multi-chip WorkerPool analog).
+
+    Same contract as :class:`gubernator_tpu.ops.engine.TickEngine` but the
+    table lives sharded across ``mesh``; total capacity is
+    ``n_shards * local_capacity``.  Key→shard routing reuses the engine's
+    slot allocator: global slot ``g`` lives on shard ``g // local_capacity``
+    at local offset ``g % local_capacity``.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        local_capacity: int = 1 << 14,
+        max_batch: int = 1024,
+    ):
+        from gubernator_tpu.ops.engine import SlotMap
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_shards = self.mesh.devices.size
+        self.local_capacity = int(local_capacity)
+        self.capacity = self.n_shards * self.local_capacity
+        self.max_batch = int(max_batch)
+
+        state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
+        self._state_shardings = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec), state_spec
+        )
+        self.state: BucketState = jax.tree.map(
+            lambda a, sh: jax.device_put(a, sh),
+            BucketState.zeros(self.capacity),
+            self._state_shardings,
+        )
+        self._tick = jax.jit(
+            make_sharded_tick_fn(self.mesh, self.local_capacity),
+            donate_argnums=(0,),
+        )
+        self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
+        # One slot allocator per shard; keys are routed to shards by hash,
+        # the mesh analog of the reference's hash-range→worker routing
+        # (workers.go:180-184).
+        self.slots = [SlotMap(self.local_capacity) for _ in range(self.n_shards)]
+        self._last_access = np.zeros(self.capacity, np.int64)
+        # Global slots assigned host-side but not yet written by a device
+        # tick; device in_use/expire_at lag for these, so reclamation must
+        # not treat them as dead (see TickEngine._pending).
+        self._pending: set = set()
+        self._tick_count = 0
+        self._lock = threading.RLock()
+        self.metric_over_limit = 0
+
+    def _shard_of(self, key: str) -> int:
+        return zlib.crc32(key.encode()) % self.n_shards
+
+    def _resolve(self, key: str, shard: int, now: int) -> tuple[Optional[int], bool]:
+        """(global slot, known) for key within its shard, reclaiming if
+        needed; slot is None when the shard is full of same-tick live slots
+        (caller spills the request to the next tick)."""
+        sm = self.slots[shard]
+        known = sm.get(key) is not None
+        local = sm.assign(key)
+        if local is None:
+            self._reclaim(shard, now)
+            known = sm.get(key) is not None  # reclaim may release the key
+            local = sm.assign(key)
+            if local is None:
+                return None, known
+        g = shard * self.local_capacity + local
+        if not known:
+            self._pending.add(g)
+        self._last_access[g] = self._tick_count
+        return g, known
+
+    def _reclaim(self, shard: int, now: int) -> None:
+        """Free expired slots in one shard; fall back to LRU eviction.
+
+        Slots assigned since the last device tick (``_pending``) look unused
+        / stale on device but are live — never release them, and device-evict
+        LRU victims so stale state can't resurrect (the TickEngine reclaim
+        rules, engine.py).
+        """
+        sm = self.slots[shard]
+        lo = shard * self.local_capacity
+        expire = np.asarray(self.state.expire_at[lo : lo + self.local_capacity])
+        in_use = np.asarray(self.state.in_use[lo : lo + self.local_capacity])
+        mapped = np.array([k is not None for k in sm._keys])
+        if self._pending:
+            pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
+            if pend:
+                mapped[np.asarray(pend, np.int64)] = False
+        # Slots already touched this tick (refreshed known keys) may look
+        # expired on device until the tick lands — they are live too.
+        mapped &= (
+            self._last_access[lo : lo + self.local_capacity] != self._tick_count
+        )
+        dead = mapped & (~in_use | (expire < now))
+        for s in np.flatnonzero(dead):
+            sm.release(int(s))
+        if np.any(dead):
+            return
+        live = np.flatnonzero(mapped)
+        if len(live) == 0:
+            return
+        n = max(1, self.local_capacity // 16)
+        victims = live[np.argsort(self._last_access[lo + live])[:n]]
+        for s in victims:
+            sm.release(int(s))
+        self.state = self._evict(
+            self.state, jnp.asarray(lo + victims, jnp.int32)
+        )
+
+    def process(
+        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
+    ) -> List[RateLimitResponse]:
+        """Apply a batch of requests; responses come back in request order.
+
+        Requests that don't fit this tick's per-shard blocks (global
+        overflow or hash skew) spill into follow-up ticks — the multi-chunk
+        analog of TickEngine's chunk loop.
+        """
+        if not requests:
+            return []
+        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            todo = list(range(len(requests)))
+            while todo:
+                left = self._tick_once(requests, todo, out, now)
+                if left == todo:  # no progress: shard genuinely full
+                    for i in left:
+                        out[i] = RateLimitResponse(
+                            error="rate-limit shard full; eviction failed"
+                        )
+                    break
+                todo = left
+        return out
+
+    def _tick_once(
+        self,
+        requests: Sequence[RateLimitRequest],
+        todo: List[int],
+        out: List[Optional[RateLimitResponse]],
+        now: int,
+    ) -> List[int]:
+        """Run one device tick over as many of ``todo`` as fit; return spill."""
+        b = self.max_batch
+        m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
+        m[:, REQ_ROW_INDEX["slot"], :] = self.local_capacity
+        fill = np.zeros(self.n_shards, np.int32)  # next free column per shard
+        where = {}  # request index → (shard, pos)
+        spill: List[int] = []
+        self._tick_count += 1
+        for i in todo:
+            r = requests[i]
+            try:
+                greg_exp, greg_dur = resolve_gregorian(r, now)
+            except timeutil.GregorianError as e:
+                out[i] = RateLimitResponse(error=str(e))
+                continue
+            key = r.hash_key()
+            shard = self._shard_of(key)
+            pos = int(fill[shard])
+            if pos >= b:
+                spill.append(i)
+                continue
+            g, known = self._resolve(key, shard, now)
+            if g is None:
+                spill.append(i)
+                continue
+            fill[shard] += 1
+            pack_request_col(
+                m[shard], pos, r,
+                slot=g - shard * self.local_capacity,
+                known=known, now=now, greg_exp=greg_exp, greg_dur=greg_dur,
+            )
+            where[i] = (shard, pos)
+
+        if where:
+            reqs_dev = jax.device_put(
+                m, NamedSharding(self.mesh, P("shard", None, None))
+            )
+            self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(now))
+            self._pending.clear()
+            rm = np.asarray(resp)  # (n_shards, 5, B)
+            for i, (shard, pos) in where.items():
+                status, limit, remaining, reset, over = rm[shard, :, pos]
+                self.metric_over_limit += int(over)
+                out[i] = RateLimitResponse(
+                    status=int(status),
+                    limit=int(limit),
+                    remaining=int(remaining),
+                    reset_time=int(reset),
+                )
+        return spill
+
+    def cache_size(self) -> int:
+        return sum(len(sm) for sm in self.slots)

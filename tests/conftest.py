@@ -26,4 +26,8 @@ os.environ["PYTHONPATH"] = ":".join(
 
 import jax  # noqa: E402
 
+# The tunnel plugin's sitecustomize may have already registered the axon
+# backend and forced jax_platforms="axon,cpu" via config (which outranks
+# the env var) — force cpu back so tests are hermetic.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
