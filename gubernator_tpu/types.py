@@ -83,6 +83,20 @@ class RateLimitResponse:
     metadata: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass(frozen=True)
+class PeerInfo:
+    """One cluster member (reference config.go:161-175)."""
+
+    grpc_address: str = ""
+    http_address: str = ""
+    datacenter: str = ""
+    is_owner: bool = False  # set only on the local instance's own entry
+
+    def hash_key(self) -> str:
+        """Ring identity of the peer (reference HashKey() = GRPCAddress)."""
+        return self.grpc_address
+
+
 @dataclass
 class HealthCheckResponse:
     status: str = "healthy"
