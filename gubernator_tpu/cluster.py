@@ -1,0 +1,140 @@
+"""In-process multi-daemon cluster for tests and local development.
+
+The reference proves "multi-node" behavior without a real cluster by
+booting N daemons in one process on loopback with statically injected peer
+lists (``cluster/cluster.go:123-189``); this is the same harness for the
+TPU build: real gRPC over loopback, real consistent hashing, real
+batching/broadcast loops — the engines all share one device.
+
+Ownership introspection helpers (``FindOwningDaemon``,
+``ListNonOwningDaemons``, ``cluster/cluster.go:81-110``) let tests target
+the exact peer that owns a key.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence
+
+from gubernator_tpu.config import BehaviorConfig, Config, DaemonConfig
+from gubernator_tpu.transport.daemon import Daemon
+from gubernator_tpu.types import PeerInfo
+
+
+def _daemon_config(
+    datacenter: str = "",
+    behaviors: Optional[BehaviorConfig] = None,
+    cache_size: int = 4096,
+) -> DaemonConfig:
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="",  # gateway off by default; tests opt in
+        peer_discovery_type="none",
+        data_center=datacenter,
+    )
+    conf.config = Config(
+        behaviors=behaviors or BehaviorConfig(),
+        cache_size=cache_size,
+        data_center=datacenter,
+    )
+    return conf
+
+
+class Cluster:
+    """N in-process daemons with a static, fully-connected peer list."""
+
+    def __init__(self):
+        self.daemons: List[Daemon] = []
+        self.peers: List[PeerInfo] = []
+
+    # ------------------------------------------------------------------
+    @classmethod
+    async def start(
+        cls,
+        n: int,
+        datacenters: Optional[Sequence[str]] = None,
+        behaviors: Optional[BehaviorConfig] = None,
+        cache_size: int = 4096,
+        http_gateway: bool = False,
+    ) -> "Cluster":
+        """Boot ``n`` daemons (dc layout via ``datacenters``, one entry per
+        daemon) and wire them into one cluster (cluster.go:123-189)."""
+        c = cls()
+        datacenters = list(datacenters or [""] * n)
+        assert len(datacenters) == n
+        for dc in datacenters:
+            conf = _daemon_config(dc, behaviors, cache_size)
+            if http_gateway:
+                conf.http_listen_address = "127.0.0.1:0"
+            d = Daemon(conf)
+            await d.start()
+            c.daemons.append(d)
+        c.peers = [
+            PeerInfo(
+                grpc_address=d.conf.grpc_listen_address,
+                http_address=d.conf.http_listen_address,
+                datacenter=d.conf.data_center,
+            )
+            for d in c.daemons
+        ]
+        for d in c.daemons:
+            d.set_peers(c.peers)
+        for d in c.daemons:
+            await d.wait_for_connect()
+        return c
+
+    async def stop(self) -> None:
+        for d in self.daemons:
+            await d.close()
+        self.daemons = []
+
+    # ------------------------------------------------------------------
+    # Ownership introspection (cluster/cluster.go:81-110)
+    # ------------------------------------------------------------------
+    def find_owning_daemon(self, name: str, key: str) -> Daemon:
+        """The daemon whose instance owns ``name_key``."""
+        d0 = self.daemons[0]
+        owner = d0.instance.get_peer(name + "_" + key)
+        addr = owner.info.grpc_address
+        for d in self.daemons:
+            if d.conf.grpc_listen_address == addr:
+                return d
+        raise RuntimeError(f"no daemon listening on {addr}")
+
+    def list_non_owning_daemons(self, name: str, key: str) -> List[Daemon]:
+        owner = self.find_owning_daemon(name, key)
+        return [d for d in self.daemons if d is not owner]
+
+    def get_random_peer(self, datacenter: str = "") -> Daemon:
+        pool = [
+            d for d in self.daemons if d.conf.data_center == datacenter
+        ]
+        return random.choice(pool)
+
+    def addresses(self) -> List[str]:
+        return [d.conf.grpc_listen_address for d in self.daemons]
+
+    async def restart(self, idx: int) -> Daemon:
+        """Stop and re-start one daemon on its old port (cluster.go:139-148)."""
+        old = self.daemons[idx]
+        addr = old.conf.grpc_listen_address
+        await old.close()
+        conf = _daemon_config(
+            old.conf.data_center,
+            old.conf.config.behaviors,
+            old.conf.config.cache_size,
+        )
+        conf.grpc_listen_address = addr
+        d = Daemon(conf)
+        await d.start()
+        d.set_peers(self.peers)
+        await d.wait_for_connect()
+        self.daemons[idx] = d
+        return d
+
+    # Metrics oracle: scrape one daemon's registry value
+    # (the reference scrapes /metrics; same idea, in-process).
+    def metric_value(self, idx: int, name: str, labels: Dict[str, str] = None):
+        return self.daemons[idx].metrics.registry.get_sample_value(
+            name, labels or {}
+        )

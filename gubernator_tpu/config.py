@@ -1,0 +1,348 @@
+"""Configuration: library Config + daemon config, env-var driven.
+
+Mirrors the reference's three-level hierarchy (``config.go:49-252``):
+``BehaviorConfig`` (batching/global cadences) inside ``Config`` (library
+instance) inside ``DaemonConfig`` (transport + discovery + TLS), with the
+same defaults (``config.go:126-141``) and the same env-first setup path
+(``SetupDaemonConfig``, ``config.go:270-479``): every knob is a ``GUBER_*``
+environment variable, and an optional ``key=value`` config file is loaded
+*into* the environment before reading (``config.go:635-658``).
+
+TPU-specific additions live in :class:`Config` and are prefixed
+``GUBER_TPU_`` (table capacity per device, tick batch size, mesh shards) —
+they replace the reference's worker-count knob (workers are goroutines
+there; here the "workers" are table shards on the device mesh).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import socket
+import string
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator")
+
+# Selector for which discovery pool the daemon runs
+# (reference daemon.go:208-243 switch).
+DISCOVERY_TYPES = ("member-list", "etcd", "dns", "k8s", "none")
+
+
+def _ms(v: float) -> float:
+    return v / 1000.0
+
+
+@dataclass
+class BehaviorConfig:
+    """Batching and GLOBAL cadence knobs (reference config.go:49-70).
+
+    Durations are seconds (floats) host-side; wire values remain ms.
+    """
+
+    # Client→owner forwarding batches.
+    batch_timeout: float = 0.5       # BatchTimeout 500ms
+    batch_wait: float = 500e-6       # BatchWait 500µs (the tick)
+    batch_limit: int = 1000          # BatchLimit
+
+    disable_batching: bool = False
+
+    # GLOBAL behavior reconciliation.
+    global_timeout: float = 0.5      # GlobalTimeout 500ms
+    global_sync_wait: float = 0.1    # GlobalSyncWait 100ms
+    global_batch_limit: int = 1000   # GlobalBatchLimit
+    global_peer_requests_concurrency: int = 100
+
+    force_global: bool = False
+
+
+@dataclass
+class Config:
+    """Library-level instance config (reference config.go:73-123)."""
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    cache_size: int = 50_000         # default table capacity (config.go:139)
+    data_center: str = ""
+    local_picker_hash: str = "fnv1"  # GUBER_PEER_PICKER_HASH
+    replicas: int = 512              # GUBER_REPLICATED_HASH_REPLICAS
+    instance_id: str = ""
+
+    # --- TPU engine knobs (new surface; no reference analog) ---
+    tpu_max_batch: int = 4096        # request columns per device tick
+    tpu_mesh_shards: int = 0         # 0 = single-chip TickEngine; N = mesh
+    tpu_platform: str = ""           # force jax platform ("cpu" for tests)
+
+    # Optional persistence hooks (reference store.go).
+    loader: Optional[object] = None
+    store: Optional[object] = None
+
+    def set_defaults(self) -> None:
+        if not self.instance_id:
+            self.instance_id = _random_instance_id()
+        if self.cache_size <= 0:
+            self.cache_size = 50_000
+
+
+@dataclass
+class TLSSettings:
+    """TLS file paths / modes (reference config.go:330-420 env surface)."""
+
+    ca_file: str = ""
+    ca_key_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    auto_tls: bool = False
+    client_auth: str = ""            # "", "request", "verify-if-given", "require", "require-and-verify"
+    client_auth_ca_file: str = ""
+    client_auth_cert_file: str = ""
+    client_auth_key_file: str = ""
+    client_auth_server_name: str = ""
+    insecure_skip_verify: bool = False
+    min_version: str = "1.3"
+
+    @property
+    def enabled(self) -> bool:
+        return bool(
+            self.auto_tls
+            or self.cert_file
+            or self.key_file
+            or self.ca_file
+        )
+
+
+@dataclass
+class DaemonConfig:
+    """Daemon-level config (reference config.go:181-252)."""
+
+    grpc_listen_address: str = "localhost:81"
+    http_listen_address: str = "localhost:80"
+    http_status_listen_address: str = ""   # optional no-mTLS health listener
+    advertise_address: str = ""
+    config: Config = field(default_factory=Config)
+    peer_discovery_type: str = "none"
+    data_center: str = ""
+    log_level: str = "info"
+    log_format: str = "text"
+    metric_flags: int = 0
+
+    # member-list discovery
+    memberlist_address: str = ""
+    memberlist_advertise_address: str = ""
+    memberlist_known_nodes: List[str] = field(default_factory=list)
+
+    # etcd discovery
+    etcd_endpoints: List[str] = field(default_factory=list)
+    etcd_key_prefix: str = "/gubernator-tpu/peers/"
+    etcd_user: str = ""
+    etcd_password: str = ""
+    etcd_dial_timeout: float = 5.0
+
+    # k8s discovery
+    k8s_namespace: str = ""
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = ""
+    k8s_endpoints_selector: str = ""
+    k8s_watch_mechanism: str = "endpoints"
+
+    # dns discovery
+    dns_fqdn: str = ""
+    dns_resolv_conf: str = "/etc/resolv.conf"
+
+    tls: TLSSettings = field(default_factory=TLSSettings)
+
+    def client_tls(self) -> Optional[TLSSettings]:
+        return self.tls if self.tls.enabled else None
+
+
+def _random_instance_id(n: int = 10) -> str:
+    """Instance id fallback (reference config.go:678-694 tries env, docker
+    cgroup, then random).  Hostname-seeded random keeps logs greppable."""
+    alphabet = string.ascii_lowercase + string.digits
+    return "".join(random.choice(alphabet) for _ in range(n))
+
+
+def load_config_file(path: str, environ: Optional[Dict[str, str]] = None) -> None:
+    """Load a ``key=value`` config file into the environment
+    (reference config.go:635-658): later ``GUBER_*`` reads see the values,
+    but real environment variables win."""
+    env = environ if environ is not None else os.environ
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"{path}:{lineno}: expected 'key=value', got {line!r}")
+            k, _, v = line.partition("=")
+            k, v = k.strip(), v.strip()
+            if k and k not in env:
+                env[k] = v
+
+
+class EnvReader:
+    """Typed ``GUBER_*`` reads with default fallbacks."""
+
+    def __init__(self, environ: Optional[Dict[str, str]] = None):
+        self.env = environ if environ is not None else os.environ
+
+    def str_(self, name: str, default: str = "") -> str:
+        v = self.env.get(name, "")
+        return v if v != "" else default
+
+    def int_(self, name: str, default: int = 0) -> int:
+        v = self.env.get(name, "")
+        if v == "":
+            return default
+        try:
+            return int(v)
+        except ValueError as e:
+            raise ValueError(f"{name}: {e}") from None
+
+    def float_seconds(self, name: str, default: float) -> float:
+        """Duration env var; accepts Go-style suffixed values (``500ms``,
+        ``30s``, ``1m``, ``100us``) or a plain float of seconds."""
+        v = self.env.get(name, "")
+        if v == "":
+            return default
+        return parse_duration(v)
+
+    def bool_(self, name: str, default: bool = False) -> bool:
+        v = self.env.get(name, "").lower()
+        if v == "":
+            return default
+        return v in ("1", "true", "yes", "on")
+
+    def list_(self, name: str, default: Optional[List[str]] = None) -> List[str]:
+        v = self.env.get(name, "")
+        if v == "":
+            return list(default or [])
+        return [x.strip() for x in v.split(",") if x.strip()]
+
+
+_DUR_UNITS = [  # ordered: longest suffix first so "ms" wins over "s"
+    ("ms", 1e-3), ("us", 1e-6), ("µs", 1e-6), ("ns", 1e-9),
+    ("s", 1.0), ("m", 60.0), ("h", 3600.0),
+]
+
+
+def parse_duration(v: str) -> float:
+    """Parse a Go-style duration string into seconds."""
+    v = v.strip()
+    for suffix, mult in _DUR_UNITS:
+        if v.endswith(suffix):
+            return float(v[: -len(suffix)]) * mult
+    return float(v)
+
+
+def setup_daemon_config(
+    config_file: str = "",
+    environ: Optional[Dict[str, str]] = None,
+) -> DaemonConfig:
+    """Build a DaemonConfig from env (+ optional config file), mirroring the
+    reference's ``SetupDaemonConfig`` (config.go:270-479)."""
+    env = dict(os.environ) if environ is None else dict(environ)
+    if config_file:
+        load_config_file(config_file, env)
+    r = EnvReader(env)
+
+    behaviors = BehaviorConfig(
+        batch_timeout=r.float_seconds("GUBER_BATCH_TIMEOUT", 0.5),
+        batch_wait=r.float_seconds("GUBER_BATCH_WAIT", 500e-6),
+        batch_limit=r.int_("GUBER_BATCH_LIMIT", 1000),
+        disable_batching=r.bool_("GUBER_DISABLE_BATCHING"),
+        global_timeout=r.float_seconds("GUBER_GLOBAL_TIMEOUT", 0.5),
+        global_sync_wait=r.float_seconds("GUBER_GLOBAL_SYNC_WAIT", 0.1),
+        global_batch_limit=r.int_("GUBER_GLOBAL_BATCH_LIMIT", 1000),
+        force_global=r.bool_("GUBER_FORCE_GLOBAL"),
+    )
+    conf = Config(
+        behaviors=behaviors,
+        cache_size=r.int_("GUBER_CACHE_SIZE", 50_000),
+        data_center=r.str_("GUBER_DATA_CENTER"),
+        local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
+        replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
+        instance_id=r.str_("GUBER_INSTANCE_ID"),
+        tpu_max_batch=r.int_("GUBER_TPU_MAX_BATCH", 4096),
+        tpu_mesh_shards=r.int_("GUBER_TPU_MESH_SHARDS", 0),
+        tpu_platform=r.str_("GUBER_TPU_PLATFORM"),
+    )
+    conf.set_defaults()
+
+    if conf.local_picker_hash not in ("fnv1", "fnv1a"):
+        raise ValueError(
+            f"GUBER_PEER_PICKER_HASH is invalid; choose one of 'fnv1', 'fnv1a'"
+        )
+    picker_type = r.str_("GUBER_PEER_PICKER", "replicated-hash")
+    if picker_type not in ("replicated-hash",):
+        raise ValueError(
+            "GUBER_PEER_PICKER is invalid; 'replicated-hash' is the only picker"
+        )
+
+    discovery = r.str_("GUBER_PEER_DISCOVERY_TYPE", "none")
+    if discovery not in DISCOVERY_TYPES:
+        raise ValueError(
+            f"GUBER_PEER_DISCOVERY_TYPE is invalid; choose one of {DISCOVERY_TYPES}"
+        )
+
+    tls = TLSSettings(
+        ca_file=r.str_("GUBER_TLS_CA"),
+        ca_key_file=r.str_("GUBER_TLS_CA_KEY"),
+        cert_file=r.str_("GUBER_TLS_CERT"),
+        key_file=r.str_("GUBER_TLS_KEY"),
+        auto_tls=r.bool_("GUBER_TLS_AUTO"),
+        client_auth=r.str_("GUBER_TLS_CLIENT_AUTH"),
+        client_auth_ca_file=r.str_("GUBER_TLS_CLIENT_AUTH_CA_CERT"),
+        client_auth_cert_file=r.str_("GUBER_TLS_CLIENT_AUTH_CERT"),
+        client_auth_key_file=r.str_("GUBER_TLS_CLIENT_AUTH_KEY"),
+        client_auth_server_name=r.str_("GUBER_TLS_CLIENT_AUTH_SERVER_NAME"),
+        insecure_skip_verify=r.bool_("GUBER_TLS_INSECURE_SKIP_VERIFY"),
+        min_version=r.str_("GUBER_TLS_MIN_VERSION", "1.3"),
+    )
+
+    return DaemonConfig(
+        grpc_listen_address=r.str_("GUBER_GRPC_ADDRESS", f"{local_host()}:81"),
+        http_listen_address=r.str_("GUBER_HTTP_ADDRESS", f"{local_host()}:80"),
+        http_status_listen_address=r.str_("GUBER_STATUS_HTTP_ADDRESS"),
+        advertise_address=r.str_("GUBER_ADVERTISE_ADDRESS"),
+        config=conf,
+        peer_discovery_type=discovery,
+        data_center=r.str_("GUBER_DATA_CENTER"),
+        log_level=r.str_("GUBER_LOG_LEVEL", "info"),
+        log_format=r.str_("GUBER_LOG_FORMAT", "text"),
+        metric_flags=r.int_("GUBER_METRIC_FLAGS", 0),
+        memberlist_address=r.str_("GUBER_MEMBERLIST_ADDRESS"),
+        memberlist_advertise_address=r.str_("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
+        memberlist_known_nodes=r.list_("GUBER_MEMBERLIST_KNOWN_NODES"),
+        etcd_endpoints=r.list_("GUBER_ETCD_ENDPOINTS", ["localhost:2379"]),
+        etcd_key_prefix=r.str_("GUBER_ETCD_KEY_PREFIX", "/gubernator-tpu/peers/"),
+        etcd_user=r.str_("GUBER_ETCD_USER"),
+        etcd_password=r.str_("GUBER_ETCD_PASSWORD"),
+        etcd_dial_timeout=r.float_seconds("GUBER_ETCD_DIAL_TIMEOUT", 5.0),
+        k8s_namespace=r.str_("GUBER_K8S_NAMESPACE", "default"),
+        k8s_pod_ip=r.str_("GUBER_K8S_POD_IP"),
+        k8s_pod_port=r.str_("GUBER_K8S_POD_PORT"),
+        k8s_endpoints_selector=r.str_("GUBER_K8S_ENDPOINTS_SELECTOR"),
+        k8s_watch_mechanism=r.str_("GUBER_K8S_WATCH_MECHANISM", "endpoints"),
+        dns_fqdn=r.str_("GUBER_DNS_FQDN"),
+        dns_resolv_conf=r.str_("GUBER_RESOLV_CONF", "/etc/resolv.conf"),
+        tls=tls,
+    )
+
+
+def local_host() -> str:
+    """Bind-address default: 'localhost' unless it doesn't resolve
+    (reference config.go:498-511 platform dance)."""
+    try:
+        socket.getaddrinfo("localhost", None)
+        return "localhost"
+    except OSError:
+        return "127.0.0.1"
+
+
+# Callback type peers flow through: discovery → daemon → instance
+# (reference config.go:177).
+UpdateFunc = Callable[[List[PeerInfo]], None]

@@ -25,6 +25,7 @@ device owns all bucket arithmetic.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -41,6 +42,7 @@ from gubernator_tpu.ops.buckets import (
 )
 from gubernator_tpu.types import (
     Behavior,
+    GlobalUpdate,
     RateLimitRequest,
     RateLimitResponse,
     has_behavior,
@@ -67,6 +69,12 @@ def _rank_within_slot(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
     rank_sorted = idx - seg_start
     rank = jnp.zeros(b, jnp.int32).at[order].set(rank_sorted)
     return rank
+
+
+def pad_pow2(n: int) -> int:
+    """Next power of two ≥ n: variable-width scatter batches (install/evict)
+    quantize to a few shapes so jit doesn't recompile per width."""
+    return 1 << max(0, (int(n) - 1)).bit_length()
 
 
 # Row layout of the packed request matrix (one H2D transfer per tick instead
@@ -205,6 +213,45 @@ def make_tick_fn(capacity: int):
     return tick_packed
 
 
+def make_install_fn():
+    """Jitted scatter installing owner-pushed GLOBAL state into the table.
+
+    Mirrors the reference's ``UpdatePeerGlobals`` install
+    (gubernator.go:425-459): ExpireAt comes from the pushed ``reset_time``;
+    token buckets install {status, limit, duration, remaining,
+    created_at=now}; leaky buckets install {remaining_f, limit, duration,
+    burst=limit, updated_at=now}.  ``cols`` rows: slot, algorithm, limit,
+    remaining, status, duration, reset_time, valid.
+    """
+
+    def install(state: BucketState, cols: jnp.ndarray, now: jnp.ndarray) -> BucketState:
+        slot, algo, limit, remaining, status, duration, reset_time, valid = cols
+        is_token = algo == jnp.int64(0)
+        scat = jnp.where(valid != 0, slot, jnp.int64(1) << 40)  # invalid rows drop
+
+        def put(tbl, upd):
+            return tbl.at[scat].set(upd, mode="drop")
+
+        return BucketState(
+            algorithm=put(state.algorithm, algo.astype(jnp.int32)),
+            limit=put(state.limit, limit),
+            remaining=put(state.remaining, jnp.where(is_token, remaining, jnp.int64(0))),
+            remaining_f=put(
+                state.remaining_f,
+                jnp.where(is_token, jnp.float64(0.0), remaining.astype(jnp.float64)),
+            ),
+            duration=put(state.duration, duration),
+            created_at=put(state.created_at, jnp.where(is_token, now, jnp.int64(0))),
+            updated_at=put(state.updated_at, jnp.where(is_token, jnp.int64(0), now)),
+            burst=put(state.burst, jnp.where(is_token, jnp.int64(0), limit)),
+            status=put(state.status, status.astype(jnp.int32)),
+            expire_at=put(state.expire_at, reset_time),
+            in_use=put(state.in_use, valid != 0),
+        )
+
+    return install
+
+
 def make_evict_fn():
     """Jitted slot eviction: mark a batch of slots unused (LRU reclamation)."""
 
@@ -214,6 +261,24 @@ def make_evict_fn():
         )
 
     return evict
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_tick(capacity: int):
+    """Shared jitted tick per capacity: engines pass state explicitly, so an
+    in-process multi-daemon cluster (the reference's test topology,
+    cluster/cluster.go) compiles the kernel once, not once per daemon."""
+    return jax.jit(make_tick_fn(capacity), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_evict():
+    return jax.jit(make_evict_fn(), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_install():
+    return jax.jit(make_install_fn(), donate_argnums=(0,))
 
 
 class SlotMap:
@@ -278,8 +343,9 @@ class TickEngine:
             self.state: BucketState = jax.tree.map(
                 jnp.asarray, BucketState.zeros(self.capacity)
             )
-        self._tick = jax.jit(make_tick_fn(self.capacity), donate_argnums=(0,))
-        self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
+        self._tick = _jitted_tick(self.capacity)
+        self._evict = _jitted_evict()
+        self._install = _jitted_install()
         self.slots = SlotMap(self.capacity)
         self._last_access = np.zeros(self.capacity, np.int64)
         # Slots assigned host-side but not yet written by a device tick; the
@@ -293,6 +359,18 @@ class TickEngine:
         self.metric_misses = 0
         self.metric_over_limit = 0
         self.metric_unexpired_evictions = 0
+        self._warmup()
+
+    def _warmup(self) -> None:
+        """Compile the tick/install programs now (first compile is seconds;
+        it must land at startup, not on the first live request's deadline).
+        An all-padding batch leaves the zeroed state untouched."""
+        m = np.zeros((len(REQ_ROWS), self.max_batch), np.int64)
+        m[REQ_ROW_INDEX["slot"]] = self.capacity
+        self.state, _ = self._tick(self.state, jnp.asarray(m), jnp.int64(0))
+        cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
+        self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
+        jax.block_until_ready(self.state)
 
     # ------------------------------------------------------------------
     # Host-side request preparation
@@ -342,7 +420,9 @@ class TickEngine:
         self.metric_unexpired_evictions += int(n)
         for s in victims:
             self.slots.release(int(s))
-        self.state = self._evict(self.state, jnp.asarray(victims, jnp.int32))
+        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
+        padded[: len(victims)] = victims
+        self.state = self._evict(self.state, jnp.asarray(padded))
 
     def build_batch(
         self, requests: Sequence[RateLimitRequest], now: int
@@ -409,6 +489,36 @@ class TickEngine:
                     for i in range(n)
                 )
         return out
+
+    def install_globals(
+        self, updates: Sequence[GlobalUpdate], now: Optional[int] = None
+    ) -> None:
+        """Install owner-pushed GLOBAL state (UpdatePeerGlobals receive path,
+        gubernator.go:425-459).  Writes land on device immediately (no tick),
+        so installed slots are live the moment this returns."""
+        if not updates:
+            return
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            rows = []
+            for u in updates:
+                try:
+                    slot, _ = self._resolve_slot(u.key, now)
+                except RuntimeError:
+                    continue  # table full; drop (the next broadcast retries)
+                self._last_access[slot] = self._tick_count
+                self._pending.discard(slot)  # device write happens right here
+                rows.append(
+                    (slot, u.algorithm, u.status.limit, u.status.remaining,
+                     u.status.status, u.duration, u.status.reset_time, 1)
+                )
+            if not rows:
+                return
+            cols = np.zeros((8, pad_pow2(len(rows))), np.int64)
+            cols[:, : len(rows)] = np.array(rows, np.int64).T
+            self.state = self._install(
+                self.state, jnp.asarray(cols), jnp.int64(now)
+            )
 
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)

@@ -39,11 +39,13 @@ from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
     make_evict_fn,
+    make_install_fn,
     make_tick_fn,
     pack_request_col,
+    pad_pow2,
     resolve_gregorian,
 )
-from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+from gubernator_tpu.types import GlobalUpdate, RateLimitRequest, RateLimitResponse
 from gubernator_tpu.utils import timeutil
 
 
@@ -116,6 +118,7 @@ class MeshTickEngine:
             donate_argnums=(0,),
         )
         self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
+        self._install = jax.jit(make_install_fn(), donate_argnums=(0,))
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
         # (workers.go:180-184).
@@ -128,6 +131,19 @@ class MeshTickEngine:
         self._tick_count = 0
         self._lock = threading.RLock()
         self.metric_over_limit = 0
+        self._warmup()
+
+    def _warmup(self) -> None:
+        """Compile the sharded tick at startup (see TickEngine._warmup)."""
+        m = np.zeros((self.n_shards, len(REQ_ROWS), self.max_batch), np.int64)
+        m[:, REQ_ROW_INDEX["slot"], :] = self.local_capacity
+        reqs_dev = jax.device_put(
+            m, NamedSharding(self.mesh, P("shard", None, None))
+        )
+        self.state, _ = self._tick(self.state, reqs_dev, jnp.int64(0))
+        cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
+        self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
+        jax.block_until_ready(self.state)
 
     def _shard_of(self, key: str) -> int:
         return zlib.crc32(key.encode()) % self.n_shards
@@ -185,9 +201,9 @@ class MeshTickEngine:
         victims = live[np.argsort(self._last_access[lo + live])[:n]]
         for s in victims:
             sm.release(int(s))
-        self.state = self._evict(
-            self.state, jnp.asarray(lo + victims, jnp.int32)
-        )
+        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
+        padded[: len(victims)] = lo + victims
+        self.state = self._evict(self.state, jnp.asarray(padded))
 
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
@@ -272,6 +288,33 @@ class MeshTickEngine:
                     reset_time=int(reset),
                 )
         return spill
+
+    def install_globals(
+        self, updates: Sequence[GlobalUpdate], now: Optional[int] = None
+    ) -> None:
+        """Install owner-pushed GLOBAL state (UpdatePeerGlobals receive path);
+        see TickEngine.install_globals.  Slot scatter crosses shards — XLA
+        routes each row to its owning device; this path is off the hot loop
+        (100ms broadcast cadence)."""
+        if not updates:
+            return
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            cols = []
+            for u in updates:
+                shard = self._shard_of(u.key)
+                g, _ = self._resolve(u.key, shard, now)
+                if g is None:
+                    continue  # shard full; drop this update (next broadcast retries)
+                self._pending.discard(g)
+                cols.append(
+                    (g, u.algorithm, u.status.limit, u.status.remaining,
+                     u.status.status, u.duration, u.status.reset_time, 1)
+                )
+            if cols:
+                m = np.zeros((8, pad_pow2(len(cols))), np.int64)
+                m[:, : len(cols)] = np.array(cols, np.int64).T
+                self.state = self._install(self.state, jnp.asarray(m), jnp.int64(now))
 
     def cache_size(self) -> int:
         return sum(len(sm) for sm in self.slots)

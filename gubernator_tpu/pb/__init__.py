@@ -1,0 +1,3 @@
+"""Generated protobuf stubs (see scripts/genproto.sh)."""
+
+from . import gubernator_pb2, peers_pb2  # noqa: F401

@@ -1,0 +1,4 @@
+"""Service layer: tick loop, instance router, peer client, GLOBAL manager."""
+
+from gubernator_tpu.service.instance import V1Instance, InstanceConfig  # noqa: F401
+from gubernator_tpu.service.tickloop import TickLoop  # noqa: F401

@@ -1,0 +1,204 @@
+"""GLOBAL-behavior reconciliation: async hit forwarding + owner broadcasts.
+
+The eventually-consistent half of the system (reference ``global.go``):
+
+* **Hits loop** — non-owner peers answer GLOBAL limits from local state and
+  queue the observed hits here; hits aggregate per key (sum ``hits``, OR in
+  RESET_REMAINING, ``global.go:99-112``) and flush to the owning peers when
+  ``global_batch_limit`` distinct keys accumulate or ``global_sync_wait``
+  elapses, grouped per owner, fan-out bounded by
+  ``global_peer_requests_concurrency`` (``global.go:144-187``).
+* **Broadcast loop** — the owner queues every GLOBAL state change; per
+  flush it re-reads current state with ``hits=0`` (a pure query through the
+  kernel, ``global.go:241-249``) and pushes authoritative
+  :class:`GlobalUpdate` records to every other peer (``global.go:234-283``).
+
+Both loops are asyncio tasks on the daemon's event loop; enqueueing is a
+plain dict update (the event loop serializes access, playing the role of
+the reference's channel).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import (
+    Behavior,
+    GlobalUpdate,
+    RateLimitRequest,
+    has_behavior,
+    set_behavior,
+)
+
+
+class GlobalManager:
+    """Owns the two reconciliation loops for one V1Instance."""
+
+    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None):
+        self.instance = instance
+        self.conf = behaviors
+        self.metrics = metrics
+        self._hits: Dict[str, RateLimitRequest] = {}
+        self._updates: Dict[str, RateLimitRequest] = {}
+        self._hits_kick = asyncio.Event()
+        self._updates_kick = asyncio.Event()
+        self._running = True
+        self._tasks = [
+            asyncio.create_task(self._hits_loop(), name="global-hits"),
+            asyncio.create_task(self._broadcast_loop(), name="global-broadcast"),
+        ]
+
+    # ------------------------------------------------------------------
+    # Enqueue (called from request handlers on the event loop)
+    # ------------------------------------------------------------------
+    def queue_hit(self, req: RateLimitRequest) -> None:
+        """Record a non-owner hit for async forwarding (global.go:74-78);
+        zero-hit queries are not forwarded."""
+        if req.hits == 0:
+            return
+        prev = self._hits.get(req.hash_key())
+        if prev is not None:
+            if has_behavior(req.behavior, Behavior.RESET_REMAINING):
+                prev.behavior = set_behavior(
+                    prev.behavior, Behavior.RESET_REMAINING, True
+                )
+            prev.hits += req.hits
+        else:
+            self._hits[req.hash_key()] = RateLimitRequest(**vars(req))
+        if self.metrics is not None:
+            self.metrics.global_send_queue_length.set(len(self._hits))
+        self._hits_kick.set()
+
+    def queue_update(self, req: RateLimitRequest) -> None:
+        """Record an owner-side state change for broadcast (global.go:80-84)."""
+        if req.hits == 0:
+            return
+        self._updates[req.hash_key()] = req
+        if self.metrics is not None:
+            self.metrics.global_queue_length.set(len(self._updates))
+        self._updates_kick.set()
+
+    # ------------------------------------------------------------------
+    # Loops
+    # ------------------------------------------------------------------
+    async def _window(self, kick: asyncio.Event, queue: Dict) -> None:
+        """Wait for the first queued item, then let the window fill until
+        the sync interval elapses or the batch limit is reached."""
+        await kick.wait()
+        deadline = asyncio.get_running_loop().time() + self.conf.global_sync_wait
+        while len(queue) < self.conf.global_batch_limit:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                break
+            kick.clear()
+            try:
+                await asyncio.wait_for(kick.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        kick.clear()
+
+    async def _hits_loop(self) -> None:
+        while self._running:
+            await self._window(self._hits_kick, self._hits)
+            hits, self._hits = self._hits, {}
+            if self.metrics is not None:
+                self.metrics.global_send_queue_length.set(0)
+            if hits:
+                await self._send_hits(list(hits.values()))
+
+    async def _broadcast_loop(self) -> None:
+        while self._running:
+            await self._window(self._updates_kick, self._updates)
+            updates, self._updates = self._updates, {}
+            if self.metrics is not None:
+                self.metrics.global_queue_length.set(0)
+            if updates:
+                await self._broadcast(list(updates.values()))
+
+    async def _send_hits(self, hits: List[RateLimitRequest]) -> None:
+        """Group accumulated hits per owning peer and forward
+        (global.go:144-187)."""
+        t0 = time.perf_counter()
+        by_owner: Dict[str, tuple] = {}
+        for r in hits:
+            try:
+                peer = self.instance.get_peer(r.hash_key())
+            except Exception:
+                continue
+            if peer is None or peer.info.is_owner:
+                continue  # we own it; nothing to forward
+            addr = peer.info.grpc_address
+            if addr in by_owner:
+                by_owner[addr][1].append(r)
+            else:
+                by_owner[addr] = (peer, [r])
+        sem = asyncio.Semaphore(self.conf.global_peer_requests_concurrency)
+        limit = self.conf.global_batch_limit
+
+        async def send(peer, reqs):
+            # Chunk per RPC: queue_hit can outrun the flush window, and the
+            # owner rejects batches over MAX_BATCH_SIZE.
+            for i in range(0, len(reqs), limit):
+                async with sem:
+                    try:
+                        await peer.get_peer_rate_limits(reqs[i : i + limit])
+                    except Exception:
+                        pass  # peer records the error for HealthCheck
+
+        await asyncio.gather(
+            *(send(p, reqs) for p, reqs in by_owner.values())
+        )
+        if self.metrics is not None:
+            self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    async def _broadcast(self, updates: List[RateLimitRequest]) -> None:
+        """Re-read current state (hits=0 query) and push it to every other
+        peer (global.go:234-283)."""
+        t0 = time.perf_counter()
+        queries = []
+        for u in updates:
+            q = RateLimitRequest(**vars(u))
+            q.hits = 0
+            queries.append(q)
+        statuses = await self.instance.apply_local(queries)
+        globals_: List[GlobalUpdate] = []
+        for u, st in zip(updates, statuses):
+            if st.error:
+                continue
+            globals_.append(
+                GlobalUpdate(
+                    key=u.hash_key(),
+                    status=st,
+                    algorithm=u.algorithm,
+                    duration=u.duration,
+                    created_at=u.created_at or 0,
+                )
+            )
+        if not globals_:
+            return
+        sem = asyncio.Semaphore(self.conf.global_peer_requests_concurrency)
+        limit = self.conf.global_batch_limit
+
+        async def push(peer):
+            for i in range(0, len(globals_), limit):
+                async with sem:
+                    try:
+                        await peer.update_peer_globals(globals_[i : i + limit])
+                    except Exception:
+                        pass
+
+        peers = [
+            p for p in self.instance.get_peer_list() if not p.info.is_owner
+        ]
+        await asyncio.gather(*(push(p) for p in peers))
+        if self.metrics is not None:
+            self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+
+    async def close(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)

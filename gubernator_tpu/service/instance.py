@@ -1,0 +1,460 @@
+"""V1Instance: the request router (ownership decision + 3-way dispatch).
+
+The service brain (reference ``gubernator.go:183-295``): for every item in
+a GetRateLimits batch decide — local (we own the key), GLOBAL (answer from
+local state, reconcile async), or forward (batched RPC to the owning peer,
+≤5 retries with ownership re-resolution, ``gubernator.go:311-391``).
+
+TPU-native deltas from the reference:
+
+* All local work flows through the :class:`TickLoop` — one device tick per
+  batch window instead of per-key worker dispatch.  Local items in one call
+  are submitted *together*.
+* A standalone instance (``set_peers`` never called) treats every key as
+  local, so a single-node service needs no cluster bootstrap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.parallel.hashring import (
+    HASH_FUNCTIONS,
+    RegionPicker,
+    ReplicatedConsistentHash,
+)
+from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.peer_client import PeerClient
+from gubernator_tpu.service.tickloop import TickLoop
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    Behavior,
+    GlobalUpdate,
+    HealthCheckResponse,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    Status,
+    has_behavior,
+    set_behavior,
+)
+from gubernator_tpu.utils import timeutil
+from gubernator_tpu.utils.metrics import Metrics
+
+log = logging.getLogger("gubernator.instance")
+
+
+class BatchTooLargeError(ValueError):
+    """Maps to gRPC OutOfRange at the transport edge (gubernator.go:189-193)."""
+
+
+@dataclass
+class InstanceConfig:
+    """Wiring for one V1Instance (reference Config, config.go:73-123)."""
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+    cache_size: int = 50_000
+    data_center: str = ""
+    advertise_address: str = ""          # this node's own grpc address
+    picker_hash: str = "fnv1"
+    replicas: int = 512
+    tpu_max_batch: int = 4096
+    tpu_mesh_shards: int = 0             # 0 = single-chip engine
+    tpu_platform: str = ""               # force jax platform ("cpu" for tests)
+    loader: Optional[object] = None
+    store: Optional[object] = None
+    metrics: Optional[Metrics] = None
+    peer_credentials: Optional[grpc.ChannelCredentials] = None
+
+    @classmethod
+    def from_config(cls, conf: Config, advertise_address: str = "", **kw):
+        return cls(
+            behaviors=conf.behaviors,
+            cache_size=conf.cache_size,
+            data_center=conf.data_center,
+            advertise_address=advertise_address,
+            picker_hash=conf.local_picker_hash,
+            replicas=conf.replicas,
+            tpu_max_batch=conf.tpu_max_batch,
+            tpu_mesh_shards=conf.tpu_mesh_shards,
+            tpu_platform=conf.tpu_platform,
+            loader=conf.loader,
+            store=conf.store,
+            **kw,
+        )
+
+
+def _make_engine(conf: InstanceConfig):
+    import jax
+
+    if conf.tpu_platform:
+        # GUBER_TPU_PLATFORM: pin the jax platform before any device use
+        # (e.g. "cpu" for tests/CI hosts without a TPU).
+        jax.config.update("jax_platforms", conf.tpu_platform)
+    if conf.tpu_mesh_shards > 1:
+        from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+
+        devices = jax.devices()[: conf.tpu_mesh_shards]
+        local_cap = max(1, conf.cache_size // len(devices))
+        return MeshTickEngine(
+            mesh=make_mesh(devices),
+            local_capacity=local_cap,
+            max_batch=conf.tpu_max_batch,
+        )
+    from gubernator_tpu.ops.engine import TickEngine
+
+    return TickEngine(capacity=conf.cache_size, max_batch=conf.tpu_max_batch)
+
+
+class V1Instance:
+    """One service instance: engine + tick loop + pickers + GLOBAL manager.
+
+    Create inside a running event loop (the GLOBAL manager starts its asyncio
+    tasks immediately, like the reference's ``NewV1Instance`` spawning its
+    loops, gubernator.go:115-148) — or via :meth:`create` which also runs the
+    Loader restore.
+    """
+
+    def __init__(self, conf: InstanceConfig, engine=None):
+        self.conf = conf
+        self.log = log
+        self.metrics = conf.metrics or Metrics()
+        self.engine = engine if engine is not None else _make_engine(conf)
+        self.tick_loop = TickLoop(
+            self.engine,
+            batch_wait=conf.behaviors.batch_wait,
+            batch_limit=conf.behaviors.batch_limit,
+            metrics=self.metrics,
+        )
+        hash_fn = HASH_FUNCTIONS[conf.picker_hash]
+        self.local_picker: ReplicatedConsistentHash[PeerClient] = (
+            ReplicatedConsistentHash(hash_fn, conf.replicas)
+        )
+        self.region_picker: RegionPicker[PeerClient] = RegionPicker(
+            hash_fn, conf.replicas
+        )
+        self.global_mgr = GlobalManager(self, conf.behaviors, self.metrics)
+        self._closed = False
+
+    @classmethod
+    async def create(cls, conf: InstanceConfig, engine=None) -> "V1Instance":
+        inst = cls(conf, engine)
+        if conf.loader is not None:
+            items = conf.loader.load()
+            inst.engine.load_items(list(items))
+        return inst
+
+    # ------------------------------------------------------------------
+    # Public API: GetRateLimits
+    # ------------------------------------------------------------------
+    async def get_rate_limits(
+        self, requests: Sequence[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """The 3-way dispatch (gubernator.go:183-295); responses in request
+        order."""
+        if len(requests) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels(error="Request too large").inc()
+            raise BatchTooLargeError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        self.metrics.concurrent_checks.inc()
+        try:
+            return await self._get_rate_limits(requests)
+        finally:
+            self.metrics.concurrent_checks.dec()
+
+    async def _get_rate_limits(
+        self, requests: Sequence[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        created_at = timeutil.now_ms()
+        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        local_idx: List[int] = []
+        global_idx: List[tuple] = []   # (i, owner_addr)
+        forward: List[tuple] = []      # (i, peer, req, key)
+
+        for i, req in enumerate(requests):
+            key = req.hash_key()
+            if req.unique_key == "":
+                self.metrics.check_error_counter.labels(error="Invalid request").inc()
+                out[i] = RateLimitResponse(error="field 'unique_key' cannot be empty")
+                continue
+            if req.name == "":
+                self.metrics.check_error_counter.labels(error="Invalid request").inc()
+                out[i] = RateLimitResponse(error="field 'namespace' cannot be empty")
+                continue
+            if req.created_at is None or req.created_at == 0:
+                req.created_at = created_at
+            if self.conf.behaviors.force_global:
+                req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, True)
+
+            peer = self.get_peer(key)
+            if peer is None or peer.info.is_owner:
+                local_idx.append(i)
+            elif has_behavior(req.behavior, Behavior.GLOBAL):
+                global_idx.append((i, peer.info.grpc_address))
+            else:
+                forward.append((i, peer, req, key))
+
+        # Local items: one tick-loop submission for the whole call.
+        locals_done = None
+        if local_idx:
+            locals_done = self._submit_local(
+                [requests[i] for i in local_idx], is_owner=True
+            )
+
+        # GLOBAL non-owner items: answer from local state, reconcile async.
+        globals_done = None
+        if global_idx:
+            globals_done = asyncio.ensure_future(
+                self._get_global_rate_limits(
+                    [requests[i] for i, _ in global_idx]
+                )
+            )
+
+        # Forwarded items: per-item task with retry/ownership-reresolution.
+        fwd_tasks = [
+            asyncio.ensure_future(self._async_request(peer, req, key))
+            for _, peer, req, key in forward
+        ]
+
+        if locals_done is not None:
+            for i, resp in zip(local_idx, await locals_done):
+                out[i] = resp
+        if globals_done is not None:
+            for (i, owner), resp in zip(global_idx, await globals_done):
+                resp.metadata = {"owner": owner}
+                out[i] = resp
+        for (i, _, _, _), t in zip(forward, fwd_tasks):
+            out[i] = await t
+        return out  # type: ignore[return-value]
+
+    def _submit_local(self, reqs: List[RateLimitRequest], *, is_owner: bool):
+        """Send a batch through the tick loop; wraps the future for await and
+        handles GLOBAL owner-side queueing + metrics."""
+
+        async def run():
+            resps = await asyncio.wrap_future(self.tick_loop.submit(reqs))
+            for req, resp in zip(reqs, resps):
+                if has_behavior(req.behavior, Behavior.GLOBAL):
+                    self.global_mgr.queue_update(req)
+                if is_owner:
+                    self.metrics.getratelimit_counter.labels(calltype="local").inc()
+                    if resp.status == Status.OVER_LIMIT:
+                        self.metrics.over_limit_counter.inc()
+            return resps
+
+        return asyncio.ensure_future(run())
+
+    async def apply_local(
+        self, reqs: List[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """Apply requests to the local engine with no routing/queueing — the
+        GLOBAL manager's state re-read path (global.go:241-249)."""
+        return await asyncio.wrap_future(self.tick_loop.submit(reqs))
+
+    async def _get_global_rate_limits(
+        self, reqs: List[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """Non-owner GLOBAL path (gubernator.go:395-421): answer from local
+        state as if we owned it, then queue the hits for reconciliation."""
+        clones = []
+        for r in reqs:
+            c = RateLimitRequest(**vars(r))
+            c.behavior = set_behavior(c.behavior, Behavior.NO_BATCHING, True)
+            c.behavior = set_behavior(c.behavior, Behavior.GLOBAL, False)
+            clones.append(c)
+        resps = await asyncio.wrap_future(self.tick_loop.submit(clones))
+        for r in reqs:
+            self.global_mgr.queue_hit(r)
+            self.metrics.getratelimit_counter.labels(calltype="global").inc()
+        return resps
+
+    async def _async_request(
+        self, peer: PeerClient, req: RateLimitRequest, key: str
+    ) -> RateLimitResponse:
+        """Forward one item to its owner, ≤5 retries on timeout with fresh
+        owner resolution, self-upgrading if ownership moved here
+        (gubernator.go:311-391)."""
+        attempts = 0
+        last_err: Optional[Exception] = None
+        while True:
+            if attempts > 5:
+                self.metrics.check_error_counter.labels(error="Peer not connected").inc()
+                return RateLimitResponse(
+                    error=f"GetPeer() keeps returning peers that are not "
+                    f"connected for '{key}': {last_err}"
+                )
+            if attempts != 0 and peer.info.is_owner:
+                resps = await self._submit_local([req], is_owner=True)
+                return resps[0]
+            try:
+                resp = await peer.get_peer_rate_limit(req)
+            except grpc.aio.AioRpcError as e:
+                if e.code() in (
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    grpc.StatusCode.CANCELLED,
+                    grpc.StatusCode.UNAVAILABLE,
+                ):
+                    attempts += 1
+                    last_err = e
+                    self.metrics.batch_send_retries.inc()
+                    peer = self.get_peer(key) or peer
+                    continue
+                return RateLimitResponse(
+                    error=f"Error while fetching rate limit '{key}' from peer: "
+                    f"{e.details()}"
+                )
+            except Exception as e:
+                return RateLimitResponse(
+                    error=f"Error while fetching rate limit '{key}' from peer: {e}"
+                )
+            self.metrics.getratelimit_counter.labels(calltype="forward").inc()
+            resp.metadata = {"owner": peer.info.grpc_address}
+            return resp
+
+    # ------------------------------------------------------------------
+    # Peer API (PeersV1)
+    # ------------------------------------------------------------------
+    async def get_peer_rate_limits(
+        self, requests: Sequence[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """Owner-side handling of relayed batches (gubernator.go:462-539):
+        forwarded GLOBAL hits get DRAIN_OVER_LIMIT forced."""
+        if len(requests) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels(error="Request too large").inc()
+            raise BatchTooLargeError(
+                f"'PeerRequest.rate_limits' list too large; max size is "
+                f"'{MAX_BATCH_SIZE}'"
+            )
+        created_at = timeutil.now_ms()
+        for req in requests:
+            if has_behavior(req.behavior, Behavior.GLOBAL):
+                req.behavior = set_behavior(
+                    req.behavior, Behavior.DRAIN_OVER_LIMIT, True
+                )
+            if req.created_at is None or req.created_at == 0:
+                req.created_at = created_at
+        return await self._submit_local(list(requests), is_owner=True)
+
+    async def update_peer_globals(self, updates: Sequence[GlobalUpdate]) -> None:
+        """Install owner-pushed GLOBAL state (gubernator.go:425-459).
+
+        Runs in a worker thread: install is device work (and may trigger a
+        one-off XLA compile for a new scatter width) — it must not stall the
+        event loop.
+        """
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.install_globals, list(updates)
+        )
+
+    # ------------------------------------------------------------------
+    # Health / peers
+    # ------------------------------------------------------------------
+    def health_check(self) -> HealthCheckResponse:
+        """Aggregate recent per-peer errors (gubernator.go:542-586)."""
+        errs: List[str] = []
+        local_peers = self.local_picker.peers()
+        for p in local_peers:
+            for msg in p.get_last_err():
+                errs.append(f"error returned from local peer.GetLastErr: {msg}")
+        region_peers = self.region_picker.peers()
+        for p in region_peers:
+            for msg in p.get_last_err():
+                errs.append(f"error returned from region peer.GetLastErr: {msg}")
+        return HealthCheckResponse(
+            status="unhealthy" if errs else "healthy",
+            message="|".join(errs),
+            peer_count=len(local_peers) + len(region_peers),
+        )
+
+    def set_peers(self, peer_info: Sequence[PeerInfo]) -> None:
+        """Install a new peer set (gubernator.go:616-711): reuse existing
+        clients, mark our own entry as owner, shut down removed peers."""
+        local = self.local_picker.new()
+        region = self.region_picker.new()
+        replaced: List[PeerClient] = []
+        for info in peer_info:
+            if info.grpc_address == self.conf.advertise_address:
+                info = PeerInfo(
+                    grpc_address=info.grpc_address,
+                    http_address=info.http_address,
+                    datacenter=info.datacenter,
+                    is_owner=True,
+                )
+            if info.datacenter and info.datacenter != self.conf.data_center:
+                peer = self.region_picker.get_by_address(info.grpc_address)
+                if peer is None:
+                    peer = self._new_peer_client(info)
+                region.add(peer)
+                continue
+            peer = self.local_picker.get_by_address(info.grpc_address)
+            if peer is not None and peer.info != info:
+                replaced.append(peer)  # same address, changed info: re-dial
+                peer = None
+            if peer is None:
+                peer = self._new_peer_client(info)
+            local.add(peer)
+
+        old_local, old_region = self.local_picker, self.region_picker
+        self.local_picker, self.region_picker = local, region
+
+        # Gracefully drain removed (and replaced) peers.
+        doomed = replaced + [
+            p
+            for p in old_local.peers()
+            if local.get_by_address(p.info.grpc_address) is None
+        ]
+        for picker in old_region.pickers().values():
+            doomed.extend(
+                p
+                for p in picker.peers()
+                if region.get_by_address(p.info.grpc_address) is None
+            )
+        for p in doomed:
+            try:
+                asyncio.get_running_loop().create_task(p.shutdown())
+            except RuntimeError:
+                pass  # no loop (tests building instances synchronously)
+
+    def _new_peer_client(self, info: PeerInfo) -> PeerClient:
+        return PeerClient(
+            info,
+            behaviors=self.conf.behaviors,
+            channel_credentials=self.conf.peer_credentials,
+            metrics=self.metrics,
+        )
+
+    def get_peer(self, key: str) -> Optional[PeerClient]:
+        """Owning peer for a key; None when no peers are set (standalone →
+        local processing)."""
+        if len(self.local_picker) == 0:
+            return None
+        return self.local_picker.get(key)
+
+    def get_peer_list(self) -> List[PeerClient]:
+        return self.local_picker.peers()
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    async def close(self) -> None:
+        """Stop loops, drain peers, run Loader.Save (gubernator.go:151-170)."""
+        if self._closed:
+            return
+        self._closed = True
+        await self.global_mgr.close()
+        for p in set(self.local_picker.peers()) | set(self.region_picker.peers()):
+            try:
+                await p.shutdown()
+            except Exception:
+                pass
+        if self.conf.loader is not None:
+            self.conf.loader.save(self.engine.export_items())
+        self.tick_loop.close()
+        self.metrics.cache_size.set(self.engine.cache_size())

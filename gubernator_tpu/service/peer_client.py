@@ -1,0 +1,240 @@
+"""Async peer RPC client with request batching.
+
+The TPU-native counterpart of the reference's ``peer_client.go``: one gRPC
+connection per peer, an app-level batching queue in front of it (flush at
+``batch_limit`` items or ``batch_wait`` after the first enqueue — the same
+window policy as ``peer_client.go:284-337``), strict order-preserving
+response distribution (``:390-398``), a TTL'd error record feeding
+HealthCheck (``:206-235``), and graceful drain on shutdown (``:408-435``).
+
+Differences from the reference are idiomatic, not semantic: goroutine +
+channel plumbing becomes one asyncio task per peer; the one-shot interval
+timer becomes ``asyncio.wait_for`` deadlines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import List, Optional, Sequence
+
+import grpc
+import grpc.aio
+
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.pb import gubernator_pb2 as pb
+from gubernator_tpu.pb import peers_pb2 as peers_pb
+from gubernator_tpu.transport import convert
+from gubernator_tpu.transport.grpc_api import PeersV1Stub
+from gubernator_tpu.types import (
+    Behavior,
+    GlobalUpdate,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+
+
+class ErrorRecorder:
+    """Recent peer-error strings with TTL expiry (reference keeps a 5-minute
+    TTL LRU per peer, peer_client.go:206-235); feeds HealthCheck."""
+
+    def __init__(self, ttl: float = 300.0, cap: int = 100):
+        self.ttl = ttl
+        self.cap = cap
+        self._errs: "collections.OrderedDict[str, float]" = collections.OrderedDict()
+
+    def record(self, msg: str) -> None:
+        now = time.monotonic()
+        self._errs.pop(msg, None)
+        self._errs[msg] = now
+        while len(self._errs) > self.cap:
+            self._errs.popitem(last=False)
+
+    def errors(self) -> List[str]:
+        cutoff = time.monotonic() - self.ttl
+        for k in [k for k, t in self._errs.items() if t < cutoff]:
+            del self._errs[k]
+        return list(self._errs.keys())
+
+
+class PeerClient:
+    """RPC client for one peer, with batched GetPeerRateLimits."""
+
+    def __init__(
+        self,
+        info: PeerInfo,
+        behaviors: Optional[BehaviorConfig] = None,
+        channel_credentials: Optional[grpc.ChannelCredentials] = None,
+        metrics=None,
+    ):
+        self._info = info
+        self.behaviors = behaviors or BehaviorConfig()
+        self.credentials = channel_credentials
+        self.metrics = metrics
+        self.last_errs = ErrorRecorder()
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._stub: Optional[PeersV1Stub] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._batch_task: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+        self._closed = False
+
+    # `info` is attribute-or-callable in pickers; plain attribute here.
+    @property
+    def info(self) -> PeerInfo:
+        return self._info
+
+    def _ensure_channel(self) -> PeersV1Stub:
+        if self._stub is None:
+            if self.credentials is not None:
+                self._channel = grpc.aio.secure_channel(
+                    self._info.grpc_address, self.credentials
+                )
+            else:
+                self._channel = grpc.aio.insecure_channel(self._info.grpc_address)
+            self._stub = PeersV1Stub(self._channel)
+        return self._stub
+
+    def _ensure_batch_loop(self) -> asyncio.Queue:
+        if self._queue is None:
+            self._queue = asyncio.Queue(maxsize=1000)  # peer_client.go:87
+            self._batch_task = asyncio.create_task(self._batch_loop())
+        return self._queue
+
+    # ------------------------------------------------------------------
+    # Public surface
+    # ------------------------------------------------------------------
+    async def get_peer_rate_limit(self, req: RateLimitRequest) -> RateLimitResponse:
+        """Forward one request to this peer, batching unless the request or
+        config opts out (peer_client.go:125-161)."""
+        if (
+            has_behavior(req.behavior, Behavior.NO_BATCHING)
+            or self.behaviors.disable_batching
+        ):
+            resp = await self.get_peer_rate_limits([req])
+            return resp[0]
+        if self._closed:
+            raise RuntimeError("peer client is shut down")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        q = self._ensure_batch_loop()
+        if self.metrics is not None:
+            self.metrics.batch_queue_length.labels(
+                peerAddr=self._info.grpc_address
+            ).set(q.qsize())
+        await q.put((req, fut))
+        return await fut
+
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitRequest]
+    ) -> List[RateLimitResponse]:
+        """One unbatched GetPeerRateLimits RPC; responses in request order."""
+        stub = self._ensure_channel()
+        msg = peers_pb.GetPeerRateLimitsReq(
+            requests=[convert.req_to_pb(r) for r in reqs]
+        )
+        try:
+            out = await stub.GetPeerRateLimits(
+                msg, timeout=self.behaviors.batch_timeout
+            )
+        except grpc.aio.AioRpcError as e:
+            self.last_errs.record(
+                f"while fetching rate limits from peer "
+                f"{self._info.grpc_address}: {e.details()}"
+            )
+            raise
+        if len(out.rate_limits) != len(reqs):
+            raise RuntimeError(
+                "server responded with incorrect rate limit list size"
+            )
+        return [convert.resp_from_pb(r) for r in out.rate_limits]
+
+    async def update_peer_globals(self, updates: Sequence[GlobalUpdate]) -> None:
+        """Push authoritative GLOBAL state to this peer."""
+        stub = self._ensure_channel()
+        msg = peers_pb.UpdatePeerGlobalsReq()
+        for u in updates:
+            g = msg.globals.add()
+            g.key = u.key
+            g.algorithm = u.algorithm
+            g.duration = u.duration
+            g.created_at = u.created_at
+            g.status.CopyFrom(convert.resp_to_pb(u.status))
+        try:
+            await stub.UpdatePeerGlobals(msg, timeout=self.behaviors.global_timeout)
+        except grpc.aio.AioRpcError as e:
+            self.last_errs.record(
+                f"while updating peer globals on {self._info.grpc_address}: "
+                f"{e.details()}"
+            )
+            raise
+
+    def get_last_err(self) -> List[str]:
+        return self.last_errs.errors()
+
+    # ------------------------------------------------------------------
+    # Batch loop
+    # ------------------------------------------------------------------
+    async def _batch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            batch = [item]
+            deadline = loop.time() + self.behaviors.batch_wait
+            while len(batch) < self.behaviors.batch_limit:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                if nxt is None:
+                    await self._send_batch(batch)
+                    return
+                batch.append(nxt)
+            # Send concurrently so the window keeps filling during the RPC.
+            t = asyncio.create_task(self._send_batch(batch))
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _send_batch(self, batch: List[tuple]) -> None:
+        """One RPC for the whole window; distribute ordered responses, or
+        fail every waiter (peer_client.go:341-404)."""
+        t0 = time.perf_counter()
+        reqs = [r for r, _ in batch]
+        try:
+            out = await self.get_peer_rate_limits(reqs)
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        finally:
+            if self.metrics is not None:
+                self.metrics.batch_send_duration.labels(
+                    peerAddr=self._info.grpc_address
+                ).observe(time.perf_counter() - t0)
+        for (_, fut), resp in zip(batch, out):
+            if not fut.done():
+                fut.set_result(resp)
+
+    async def shutdown(self) -> None:
+        """Drain queued/in-flight work, then close the channel
+        (peer_client.go:408-435)."""
+        self._closed = True
+        if self._queue is not None:
+            await self._queue.put(None)
+        if self._batch_task is not None:
+            try:
+                await asyncio.wait_for(self._batch_task, self.behaviors.batch_timeout)
+            except asyncio.TimeoutError:
+                self._batch_task.cancel()
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+        if self._channel is not None:
+            await self._channel.close()

@@ -1,0 +1,112 @@
+"""The tick loop: batch accumulation in front of the device engine.
+
+Replaces the reference's per-request worker dispatch (``workers.go:190-258``
+channel hops) with the BASELINE.json north star: requests accumulate on the
+host and flush to the TPU once per tick.  The window policy matches the
+reference's peer-batching policy (``peer_client.go:284-337``): flush when
+``batch_limit`` requests are waiting or ``batch_wait`` has elapsed since the
+first queued request — so an idle service adds zero latency and a busy one
+amortizes the device round trip over the whole window.
+
+The loop runs on a dedicated thread (device dispatch must not block the
+asyncio transport); ``submit`` is thread-safe and returns a
+``concurrent.futures.Future`` the caller can await.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+
+class TickLoop:
+    """Accumulates request batches and applies them to an engine per tick."""
+
+    def __init__(
+        self,
+        engine,
+        batch_wait: float = 500e-6,
+        batch_limit: int = 1000,
+        metrics=None,
+    ):
+        self.engine = engine
+        self.batch_wait = float(batch_wait)
+        self.batch_limit = int(batch_limit)
+        self.metrics = metrics
+        self._cond = threading.Condition()
+        self._pending: List[tuple] = []  # (requests, future)
+        self._pending_count = 0
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True, name="tick-loop")
+        self._thread.start()
+
+    def submit(
+        self, requests: Sequence[RateLimitRequest]
+    ) -> "Future[List[RateLimitResponse]]":
+        """Queue a request batch for the next tick."""
+        fut: Future = Future()
+        if not requests:
+            fut.set_result([])
+            return fut
+        with self._cond:
+            if not self._running:
+                fut.set_exception(RuntimeError("tick loop is shut down"))
+                return fut
+            self._pending.append((list(requests), fut))
+            self._pending_count += len(requests)
+            self._cond.notify()
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._pending:
+                    self._cond.wait()
+                if not self._running and not self._pending:
+                    return
+                # Batch window: once something is queued, wait out the tick
+                # (or until the batch fills) to let more requests coalesce.
+                deadline = time.monotonic() + self.batch_wait
+                while (
+                    self._running
+                    and self._pending_count < self.batch_limit
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                batch = self._pending
+                self._pending = []
+                self._pending_count = 0
+            self._flush(batch)
+
+    def _flush(self, batch: List[tuple]) -> None:
+        reqs: List[RateLimitRequest] = []
+        for r, _ in batch:
+            reqs.extend(r)
+        t0 = time.perf_counter()
+        try:
+            out = self.engine.process(reqs)
+        except Exception as e:  # engine failure fails every waiter in the tick
+            for _, fut in batch:
+                if not fut.cancelled():
+                    fut.set_exception(e)
+            return
+        if self.metrics is not None:
+            self.metrics.tick_duration.observe(time.perf_counter() - t0)
+            self.metrics.tick_batch_size.observe(len(reqs))
+        off = 0
+        for r, fut in batch:
+            if not fut.cancelled():  # waiter may have timed out/cancelled
+                fut.set_result(out[off : off + len(r)])
+            off += len(r)
+
+    def close(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify()
+        self._thread.join(timeout=5)

@@ -1,0 +1,1 @@
+"""Transport layer: gRPC bindings, JSON gateway, daemon shell."""

@@ -1,0 +1,79 @@
+"""Conversions between wire protos and the engine's dataclasses.
+
+The engine layer (ops/, service) works with plain dataclasses
+(:mod:`gubernator_tpu.types`) so it has no protobuf dependency; the
+transport edge converts.  `created_at` uses proto3 `optional` presence —
+absence means "server stamps now" (reference gubernator.proto:172-182).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from gubernator_tpu.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+
+def req_from_pb(m: pb.RateLimitReq) -> RateLimitRequest:
+    return RateLimitRequest(
+        name=m.name,
+        unique_key=m.unique_key,
+        hits=m.hits,
+        limit=m.limit,
+        duration=m.duration,
+        algorithm=int(m.algorithm),
+        behavior=int(m.behavior),
+        burst=m.burst,
+        metadata=dict(m.metadata),
+        created_at=m.created_at if m.HasField("created_at") else None,
+    )
+
+
+def req_to_pb(r: RateLimitRequest) -> pb.RateLimitReq:
+    m = pb.RateLimitReq(
+        name=r.name,
+        unique_key=r.unique_key,
+        hits=r.hits,
+        limit=r.limit,
+        duration=r.duration,
+        algorithm=r.algorithm,
+        behavior=r.behavior,
+        burst=r.burst,
+    )
+    for k, v in r.metadata.items():
+        m.metadata[k] = v
+    if r.created_at is not None:
+        m.created_at = r.created_at
+    return m
+
+
+def resp_from_pb(m: pb.RateLimitResp) -> RateLimitResponse:
+    return RateLimitResponse(
+        status=int(m.status),
+        limit=m.limit,
+        remaining=m.remaining,
+        reset_time=m.reset_time,
+        error=m.error,
+        metadata=dict(m.metadata),
+    )
+
+
+def resp_to_pb(r: RateLimitResponse) -> pb.RateLimitResp:
+    m = pb.RateLimitResp(
+        status=r.status,
+        limit=r.limit,
+        remaining=r.remaining,
+        reset_time=r.reset_time,
+        error=r.error,
+    )
+    for k, v in r.metadata.items():
+        m.metadata[k] = v
+    return m
+
+
+def reqs_from_pb(ms: Iterable[pb.RateLimitReq]) -> List[RateLimitRequest]:
+    return [req_from_pb(m) for m in ms]
+
+
+def resps_to_pb(rs: Iterable[RateLimitResponse]) -> List[pb.RateLimitResp]:
+    return [resp_to_pb(r) for r in rs]

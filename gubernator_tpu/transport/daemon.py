@@ -1,0 +1,413 @@
+"""Daemon: gRPC server + HTTP-JSON gateway + metrics + lifecycle.
+
+The transport shell (reference ``daemon.go``): one grpc.aio server exposing
+``V1`` and ``PeersV1``, an aiohttp JSON gateway mirroring grpc-gateway's
+snake_case marshaling (``daemon.go:245-261``), ``/metrics`` in Prometheus
+text format, an optional plaintext status listener when mTLS is on
+(``daemon.go:305-334``), TLS/mTLS incl. AutoTLS, discovery-pool wiring
+(``daemon.go:208-243``), and ``wait_for_connect`` readiness
+(``daemon.go:451-488``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import List, Optional, Sequence
+
+import grpc
+import grpc.aio
+from aiohttp import web
+from google.protobuf import json_format
+
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.pb import gubernator_pb2 as pb
+from gubernator_tpu.pb import peers_pb2 as peers_pb
+from gubernator_tpu.service.instance import (
+    BatchTooLargeError,
+    InstanceConfig,
+    V1Instance,
+)
+from gubernator_tpu.transport import convert
+from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
+from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
+from gubernator_tpu.types import GlobalUpdate, PeerInfo
+from gubernator_tpu.utils.metrics import CONTENT_TYPE_LATEST, Metrics
+
+log = logging.getLogger("gubernator.daemon")
+
+MAX_RECV_BYTES = 1024 * 1024  # 1 MiB, daemon.go:120-126
+
+
+class _StatsInterceptor(grpc.aio.ServerInterceptor):
+    """Per-RPC count/duration metrics (reference grpc_stats.go:41-121)."""
+
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = handler_call_details.method
+        inner = handler.unary_unary
+        metrics = self.metrics
+
+        async def wrapped(request, context):
+            t0 = time.perf_counter()
+            failed = False
+            try:
+                return await inner(request, context)
+            except Exception:
+                failed = True
+                raise
+            finally:
+                metrics.grpc_request_duration.labels(method=method).observe(
+                    time.perf_counter() - t0
+                )
+                metrics.grpc_request_counts.labels(
+                    status="failed" if failed else "success", method=method
+                ).inc()
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+class V1Servicer:
+    """pb ↔ dataclass edge for the public service."""
+
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    async def GetRateLimits(self, request, context):
+        try:
+            out = await self.instance.get_rate_limits(
+                convert.reqs_from_pb(request.requests)
+            )
+        except BatchTooLargeError as e:
+            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+        return pb.GetRateLimitsResp(responses=convert.resps_to_pb(out))
+
+    async def HealthCheck(self, request, context):
+        h = self.instance.health_check()
+        return pb.HealthCheckResp(
+            status=h.status, message=h.message, peer_count=h.peer_count
+        )
+
+
+class PeersServicer:
+    """pb ↔ dataclass edge for the peer service."""
+
+    def __init__(self, instance: V1Instance):
+        self.instance = instance
+
+    async def GetPeerRateLimits(self, request, context):
+        try:
+            out = await self.instance.get_peer_rate_limits(
+                convert.reqs_from_pb(request.requests)
+            )
+        except BatchTooLargeError as e:
+            await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+        return peers_pb.GetPeerRateLimitsResp(rate_limits=convert.resps_to_pb(out))
+
+    async def UpdatePeerGlobals(self, request, context):
+        updates = [
+            GlobalUpdate(
+                key=g.key,
+                status=convert.resp_from_pb(g.status),
+                algorithm=int(g.algorithm),
+                duration=g.duration,
+                created_at=g.created_at,
+            )
+            for g in request.globals
+        ]
+        await self.instance.update_peer_globals(updates)
+        return peers_pb.UpdatePeerGlobalsResp()
+
+
+class Daemon:
+    """One running node: instance + listeners + discovery."""
+
+    def __init__(self, conf: DaemonConfig, engine=None):
+        self.conf = conf
+        self.metrics = Metrics()
+        self.instance: Optional[V1Instance] = None
+        self._engine = engine
+        self._grpc_server: Optional[grpc.aio.Server] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self._status_runner: Optional[web.AppRunner] = None
+        self._pool = None
+        self.tls: Optional[TLSBundle] = None
+        self.peer_info: List[PeerInfo] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def advertise_address(self) -> str:
+        return self.conf.advertise_address or self.conf.grpc_listen_address
+
+    async def start(self) -> None:
+        """Bring up instance, gRPC, gateway, discovery (daemon.go:83-366)."""
+        self.tls = setup_tls(self.conf.tls)
+        server = grpc.aio.server(
+            interceptors=[_StatsInterceptor(self.metrics)],
+            options=[
+                ("grpc.max_receive_message_length", MAX_RECV_BYTES),
+                ("grpc.max_connection_age_ms", 60 * 60 * 1000),
+            ],
+        )
+        if self.tls is not None:
+            port = server.add_secure_port(
+                self.conf.grpc_listen_address, self.tls.server_credentials()
+            )
+        else:
+            port = server.add_insecure_port(self.conf.grpc_listen_address)
+        if port == 0:
+            raise RuntimeError(
+                f"failed to bind gRPC listener {self.conf.grpc_listen_address}"
+            )
+        # Rewrite :0 binds to the allocated port so peers/tests can dial it.
+        host = self.conf.grpc_listen_address.rsplit(":", 1)[0]
+        self.conf.grpc_listen_address = f"{host}:{port}"
+
+        # The instance needs the *bound* address so set_peers can recognize
+        # this node's own entry and mark it owner — create it only now.
+        iconf = InstanceConfig.from_config(
+            self.conf.config,
+            advertise_address=self.advertise_address,
+            metrics=self.metrics,
+            peer_credentials=(
+                self.tls.channel_credentials() if self.tls else None
+            ),
+        )
+        iconf.data_center = self.conf.data_center or self.conf.config.data_center
+        self.instance = await V1Instance.create(iconf, engine=self._engine)
+        server.add_generic_rpc_handlers(
+            (
+                v1_handler(V1Servicer(self.instance)),
+                peers_handler(PeersServicer(self.instance)),
+            )
+        )
+        await server.start()
+        self._grpc_server = server
+
+        await self._start_gateway()
+        await self._start_discovery()
+        log.info(
+            "gubernator-tpu daemon up: grpc=%s http=%s",
+            self.conf.grpc_listen_address,
+            self.conf.http_listen_address,
+        )
+
+    # ------------------------------------------------------------------
+    # HTTP gateway (grpc-gateway JSON + /metrics, daemon.go:245-292)
+    # ------------------------------------------------------------------
+    def _gateway_app(self, include_metrics: bool = True) -> web.Application:
+        app = web.Application(client_max_size=MAX_RECV_BYTES)
+        app.router.add_post("/v1/GetRateLimits", self._h_get_rate_limits)
+        app.router.add_get("/v1/HealthCheck", self._h_health_check)
+        if include_metrics:
+            app.router.add_get("/metrics", self._h_metrics)
+        return app
+
+    async def _start_gateway(self) -> None:
+        if not self.conf.http_listen_address:
+            return
+        app = self._gateway_app()
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        host, _, port = self.conf.http_listen_address.rpartition(":")
+        ssl_ctx = self.tls.server_ssl_context() if self.tls else None
+        site = web.TCPSite(runner, host or "localhost", int(port), ssl_context=ssl_ctx)
+        await site.start()
+        self._http_runner = runner
+        # Rewrite :0 binds to the allocated port.
+        socks = site._server.sockets if site._server is not None else []
+        if int(port) == 0 and socks:
+            self.conf.http_listen_address = (
+                f"{host or 'localhost'}:{socks[0].getsockname()[1]}"
+            )
+        # Optional plaintext status listener for health probes behind mTLS
+        # (daemon.go:305-334).
+        if self.conf.http_status_listen_address:
+            sapp = web.Application()
+            sapp.router.add_get("/v1/HealthCheck", self._h_health_check)
+            sapp.router.add_get("/metrics", self._h_metrics)
+            srunner = web.AppRunner(sapp, access_log=None)
+            await srunner.setup()
+            shost, _, sport = self.conf.http_status_listen_address.rpartition(":")
+            await web.TCPSite(srunner, shost or "localhost", int(sport)).start()
+            self._status_runner = srunner
+
+    async def _h_get_rate_limits(self, request: web.Request) -> web.Response:
+        """JSON gateway with snake_case field names (UseProtoNames parity,
+        daemon.go:251-261)."""
+        try:
+            body = await request.read()
+            msg = json_format.Parse(body, pb.GetRateLimitsReq())
+        except json_format.ParseError as e:
+            return web.json_response({"error": str(e), "code": 3}, status=400)
+        try:
+            out = await self.instance.get_rate_limits(
+                convert.reqs_from_pb(msg.requests)
+            )
+        except BatchTooLargeError as e:
+            return web.json_response({"error": str(e), "code": 11}, status=400)
+        resp = pb.GetRateLimitsResp(responses=convert.resps_to_pb(out))
+        return web.json_response(
+            json_format.MessageToDict(
+                resp,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
+        )
+
+    async def _h_health_check(self, request: web.Request) -> web.Response:
+        h = self.instance.health_check()
+        msg = pb.HealthCheckResp(
+            status=h.status, message=h.message, peer_count=h.peer_count
+        )
+        return web.json_response(
+            json_format.MessageToDict(
+                msg,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
+        )
+
+    async def _h_metrics(self, request: web.Request) -> web.Response:
+        self.metrics.cache_size.set(self.instance.engine.cache_size())
+        return web.Response(
+            body=self.metrics.expose(), content_type="text/plain"
+        )
+
+    # ------------------------------------------------------------------
+    # Discovery (daemon.go:208-243)
+    # ------------------------------------------------------------------
+    async def _start_discovery(self) -> None:
+        kind = self.conf.peer_discovery_type
+        if kind == "none":
+            self.set_peers([self._self_info()])
+            return
+        from gubernator_tpu import discovery
+
+        info = self._self_info()
+        if kind == "dns":
+            self._pool = discovery.DNSPool(
+                fqdn=self.conf.dns_fqdn,
+                grpc_port=int(self.conf.grpc_listen_address.rsplit(":", 1)[1]),
+                http_port=int(self.conf.http_listen_address.rsplit(":", 1)[1])
+                if self.conf.http_listen_address
+                else 0,
+                on_update=self.set_peers,
+            )
+        elif kind == "etcd":
+            self._pool = discovery.EtcdPool(
+                endpoints=self.conf.etcd_endpoints,
+                key_prefix=self.conf.etcd_key_prefix,
+                info=info,
+                on_update=self.set_peers,
+            )
+        elif kind == "k8s":
+            self._pool = discovery.K8sPool(
+                namespace=self.conf.k8s_namespace,
+                selector=self.conf.k8s_endpoints_selector,
+                pod_ip=self.conf.k8s_pod_ip,
+                pod_port=self.conf.k8s_pod_port,
+                mechanism=self.conf.k8s_watch_mechanism,
+                on_update=self.set_peers,
+            )
+        elif kind == "member-list":
+            self._pool = discovery.MemberlistPool(
+                bind_address=self.conf.memberlist_address,
+                known_nodes=self.conf.memberlist_known_nodes,
+                info=info,
+                on_update=self.set_peers,
+            )
+        else:
+            raise ValueError(f"unknown peer discovery type {kind!r}")
+        await self._pool.start()
+
+    def _self_info(self) -> PeerInfo:
+        return PeerInfo(
+            grpc_address=self.advertise_address,
+            http_address=self.conf.http_listen_address,
+            datacenter=self.conf.data_center,
+        )
+
+    # ------------------------------------------------------------------
+    def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        """Install cluster membership; marks our own entry (daemon.go:399-409)."""
+        self.peer_info = list(peers)
+        self.instance.set_peers(self.peer_info)
+
+    def client(self) -> "DaemonClient":
+        """A client dialing this daemon (reference Daemon.Client, :433-447)."""
+        creds = self.tls.channel_credentials() if self.tls else None
+        return DaemonClient(self.conf.grpc_listen_address, credentials=creds)
+
+    async def wait_for_connect(self, timeout: float = 10.0) -> None:
+        """Readiness: block until the gRPC listener answers HealthCheck."""
+        client = self.client()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                await client.health_check()
+                await client.close()
+                return
+            except Exception:
+                if time.monotonic() > deadline:
+                    await client.close()
+                    raise
+                await asyncio.sleep(0.05)
+
+    async def close(self) -> None:
+        """Graceful shutdown (daemon.go:369-396)."""
+        if self._pool is not None:
+            await self._pool.close()
+        if self.instance is not None:
+            await self.instance.close()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=1.0)
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+        if self._status_runner is not None:
+            await self._status_runner.cleanup()
+
+
+class DaemonClient:
+    """Thin async client for the public V1 API (reference client.go)."""
+
+    def __init__(
+        self,
+        address: str,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ):
+        if credentials is not None:
+            self.channel = grpc.aio.secure_channel(address, credentials)
+        else:
+            self.channel = grpc.aio.insecure_channel(address)
+        self.stub = V1Stub(self.channel)
+
+    async def get_rate_limits(self, reqs, timeout: float = 5.0):
+        msg = pb.GetRateLimitsReq(requests=[convert.req_to_pb(r) for r in reqs])
+        out = await self.stub.GetRateLimits(msg, timeout=timeout)
+        return [convert.resp_from_pb(r) for r in out.responses]
+
+    async def health_check(self, timeout: float = 5.0):
+        return await self.stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+
+async def spawn_daemon(conf: DaemonConfig, engine=None) -> Daemon:
+    """Start a daemon and wait for readiness (reference SpawnDaemon,
+    daemon.go:73-81)."""
+    d = Daemon(conf, engine=engine)
+    await d.start()
+    await d.wait_for_connect()
+    return d

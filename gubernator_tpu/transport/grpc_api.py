@@ -1,0 +1,94 @@
+"""Hand-written gRPC service/client bindings for the V1 and PeersV1 services.
+
+The environment ships ``protoc`` (messages) but not the grpc codegen
+plugin, so the service plumbing the plugin would emit — method handlers on
+the server side, unary-unary stubs on the client side — is written here
+directly against the public ``grpc`` API.  Method paths match the
+reference's generated code (``/pb.gubernator.V1/GetRateLimits`` etc.,
+reference gubernator_grpc.pb.go / peers_grpc.pb.go) so reference clients
+and servers interoperate on the wire.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gubernator_tpu.pb import gubernator_pb2 as pb
+from gubernator_tpu.pb import peers_pb2 as peers_pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+def v1_handler(servicer) -> grpc.GenericRpcHandler:
+    """Generic handler for the public V1 service.
+
+    ``servicer`` provides async (or sync) methods ``GetRateLimits(req,
+    context)`` and ``HealthCheck(req, context)`` over pb messages.
+    """
+    return grpc.method_handlers_generic_handler(
+        V1_SERVICE,
+        {
+            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetRateLimits,
+                request_deserializer=pb.GetRateLimitsReq.FromString,
+                response_serializer=pb.GetRateLimitsResp.SerializeToString,
+            ),
+            "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                servicer.HealthCheck,
+                request_deserializer=pb.HealthCheckReq.FromString,
+                response_serializer=pb.HealthCheckResp.SerializeToString,
+            ),
+        },
+    )
+
+
+def peers_handler(servicer) -> grpc.GenericRpcHandler:
+    """Generic handler for the peer-to-peer PeersV1 service."""
+    return grpc.method_handlers_generic_handler(
+        PEERS_SERVICE,
+        {
+            "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetPeerRateLimits,
+                request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
+                response_serializer=peers_pb.GetPeerRateLimitsResp.SerializeToString,
+            ),
+            "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+                servicer.UpdatePeerGlobals,
+                request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
+                response_serializer=peers_pb.UpdatePeerGlobalsResp.SerializeToString,
+            ),
+        },
+    )
+
+
+class V1Stub:
+    """Client stub for the public service (works with sync or aio channels)."""
+
+    def __init__(self, channel):
+        self.GetRateLimits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=pb.GetRateLimitsReq.SerializeToString,
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        self.HealthCheck = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=pb.HealthCheckReq.SerializeToString,
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+
+
+class PeersV1Stub:
+    """Client stub for the peer service."""
+
+    def __init__(self, channel):
+        self.GetPeerRateLimits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=peers_pb.GetPeerRateLimitsReq.SerializeToString,
+            response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString,
+        )
+        self.UpdatePeerGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=peers_pb.UpdatePeerGlobalsReq.SerializeToString,
+            response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
+        )

@@ -83,6 +83,18 @@ class RateLimitResponse:
     metadata: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class GlobalUpdate:
+    """Owner-pushed authoritative GLOBAL bucket state
+    (reference UpdatePeerGlobal, peers.proto:52-72)."""
+
+    key: str
+    status: "RateLimitResponse"
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    duration: int = 0
+    created_at: int = 0
+
+
 @dataclass(frozen=True)
 class PeerInfo:
     """One cluster member (reference config.go:161-175)."""

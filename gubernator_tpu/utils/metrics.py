@@ -1,0 +1,165 @@
+"""Prometheus metrics with the reference's family names.
+
+Reproduces the metric catalog spread through the reference
+(``gubernator.go:60-111``, ``lrucache.go:48-59``, ``global.go:50-67``,
+``grpc_stats.go:41-121``; full list in ``docs/prometheus.md``) so existing
+dashboards/alerts — and the metrics-as-test-oracle pattern the reference's
+distributed tests rely on (``functional_test.go:2184-2276``) — carry over
+unchanged.  Each daemon gets its own registry (the in-process test cluster
+runs many daemons per process, like ``cluster/cluster.go``).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Summary,
+    generate_latest,
+)
+
+CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class Metrics:
+    """Per-daemon metric registry (names match the reference catalog)."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        reg = self.registry
+
+        # gubernator.go:60-111 service families.
+        self.getratelimit_counter = Counter(
+            "gubernator_getratelimit_counter",
+            "The count of getLocalRateLimit() calls. Label \"calltype\" may "
+            "be \"local\" for calls handled by the same peer, \"forward\" for "
+            "calls forwarded to another peer, or \"global\" for global rate limits.",
+            ["calltype"],
+            registry=reg,
+        )
+        self.func_duration = Summary(
+            "gubernator_func_duration",
+            "The timings of key functions in Gubernator in seconds.",
+            ["name"],
+            registry=reg,
+        )
+        self.over_limit_counter = Counter(
+            "gubernator_over_limit_counter",
+            "The number of rate limit checks that are over the limit.",
+            registry=reg,
+        )
+        self.concurrent_checks = Gauge(
+            "gubernator_concurrent_checks_counter",
+            "The number of concurrent GetRateLimits API calls.",
+            registry=reg,
+        )
+        self.check_error_counter = Counter(
+            "gubernator_check_error_counter",
+            "The number of errors while checking rate limits.",
+            ["error"],
+            registry=reg,
+        )
+        self.command_counter = Counter(
+            "gubernator_command_counter",
+            "The count of commands processed by each worker in WorkerPool.",
+            ["worker", "method"],
+            registry=reg,
+        )
+        self.worker_queue_length = Gauge(
+            "gubernator_worker_queue_length",
+            "The count of requests queued up in WorkerPool.",
+            ["method", "worker"],
+            registry=reg,
+        )
+
+        # Batch-forwarding families (gubernator.go:95-110).
+        self.batch_send_duration = Summary(
+            "gubernator_batch_send_duration",
+            "The timings of batch send operations to a remote peer.",
+            ["peerAddr"],
+            registry=reg,
+        )
+        self.batch_send_retries = Counter(
+            "gubernator_batch_send_retries",
+            "The count of retries occurred in asyncRequest() forwarding a "
+            "request to another peer.",
+            registry=reg,
+        )
+        self.batch_queue_length = Gauge(
+            "gubernator_batch_queue_length",
+            "The getRateLimitsBatch() queue length in PeerClient.",
+            ["peerAddr"],
+            registry=reg,
+        )
+
+        # GLOBAL manager families (global.go:50-67).
+        self.global_send_duration = Summary(
+            "gubernator_global_send_duration",
+            "The duration of GLOBAL async sends in seconds.",
+            registry=reg,
+        )
+        self.broadcast_duration = Summary(
+            "gubernator_broadcast_duration",
+            "The duration of GLOBAL broadcasts to peers in seconds.",
+            registry=reg,
+        )
+        self.global_send_queue_length = Gauge(
+            "gubernator_global_send_queue_length",
+            "The count of requests queued up for global broadcast.",
+            registry=reg,
+        )
+        self.global_queue_length = Gauge(
+            "gubernator_global_queue_length",
+            "The count of requests queued up for update all peers.",
+            registry=reg,
+        )
+
+        # Cache families (lrucache.go:48-59 + collector :180-214).
+        self.cache_size = Gauge(
+            "gubernator_cache_size",
+            "The number of items in LRU Cache which holds the rate limits.",
+            registry=reg,
+        )
+        self.cache_access_count = Counter(
+            "gubernator_cache_access_count",
+            "Cache access counts. Label \"type\" = \"miss\" or \"hit\".",
+            ["type"],
+            registry=reg,
+        )
+        self.unexpired_evictions = Counter(
+            "gubernator_unexpired_evictions_count",
+            "Count the number of cache items which were evicted while "
+            "unexpired.",
+            registry=reg,
+        )
+
+        # gRPC stats families (grpc_stats.go:41-121).
+        self.grpc_request_counts = Counter(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC requests.",
+            ["status", "method"],
+            registry=reg,
+        )
+        self.grpc_request_duration = Summary(
+            "gubernator_grpc_request_duration",
+            "The timings of gRPC requests in seconds.",
+            ["method"],
+            registry=reg,
+        )
+
+        # TPU-native additions (no reference analog): device tick telemetry.
+        self.tick_duration = Summary(
+            "gubernator_tpu_tick_duration",
+            "Wall time of one device tick (H2D + kernel + D2H) in seconds.",
+            registry=reg,
+        )
+        self.tick_batch_size = Summary(
+            "gubernator_tpu_tick_batch_size",
+            "Requests applied per device tick.",
+            registry=reg,
+        )
+
+    def expose(self) -> bytes:
+        """Render the registry in Prometheus text exposition format."""
+        return generate_latest(self.registry)

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate protobuf stubs into gubernator_tpu/pb.
+# protoc emits absolute imports between generated modules; rewrite them to
+# package-relative so the stubs work inside the gubernator_tpu.pb package.
+set -e
+cd "$(dirname "$0")/../gubernator_tpu/proto"
+protoc --python_out=../pb gubernator.proto peers.proto
+sed -i 's/^import gubernator_pb2 as/from . import gubernator_pb2 as/' ../pb/peers_pb2.py

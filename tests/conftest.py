@@ -31,3 +31,31 @@ import jax  # noqa: E402
 # the env var) — force cpu back so tests are hermetic.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+# ---------------------------------------------------------------------------
+# Minimal asyncio test support (pytest-asyncio isn't in the image):
+# coroutine tests run on the module-scoped `event_loop` fixture when they
+# (or their fixtures) request it, else on a fresh loop.
+# ---------------------------------------------------------------------------
+import asyncio
+import inspect
+
+import pytest
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in pyfuncitem._fixtureinfo.argnames
+    }
+    loop = pyfuncitem.funcargs.get("event_loop")
+    if loop is not None:
+        loop.run_until_complete(fn(**kwargs))
+    else:
+        asyncio.run(fn(**kwargs))
+    return True

@@ -1,0 +1,229 @@
+"""Service-stack functional tests: daemon, routing, forwarding, gateway.
+
+The behavioral spec comes from the reference's functional_test.go (run
+against an in-process cluster, cluster/cluster.go); these tests exercise
+the same surfaces over real loopback gRPC.
+"""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitRequest,
+    Status,
+)
+
+@pytest.fixture(scope="module")
+def event_loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def cluster(event_loop):
+    c = event_loop.run_until_complete(Cluster.start(3))
+    yield c
+    event_loop.run_until_complete(c.stop())
+
+
+def req(name="test", key="k", hits=1, limit=5, duration=60_000, **kw):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=duration, **kw
+    )
+
+
+async def test_single_daemon_token_bucket(cluster):
+    client = cluster.daemons[0].client()
+    out = await client.get_rate_limits([req(key="single")])
+    assert out[0].error == ""
+    assert out[0].status == Status.UNDER_LIMIT
+    assert out[0].limit == 5
+    assert out[0].remaining == 4
+    out = await client.get_rate_limits([req(key="single", hits=4)])
+    assert out[0].remaining == 0
+    out = await client.get_rate_limits([req(key="single", hits=1)])
+    assert out[0].status == Status.OVER_LIMIT
+    await client.close()
+
+
+async def test_forwarding_owner_state_shared(cluster):
+    """Hitting the same key via different daemons must share one bucket."""
+    owner = cluster.find_owning_daemon("fwd", "shared")
+    non_owner = cluster.list_non_owning_daemons("fwd", "shared")[0]
+    c1 = owner.client()
+    c2 = non_owner.client()
+    out = await c1.get_rate_limits([req(name="fwd", key="shared", limit=10)])
+    assert out[0].error == ""
+    assert out[0].remaining == 9
+    out = await c2.get_rate_limits([req(name="fwd", key="shared", limit=10)])
+    assert out[0].error == ""
+    assert out[0].remaining == 8
+    # Forwarded response carries the owner's address in metadata.
+    assert out[0].metadata.get("owner") == owner.conf.grpc_listen_address
+    await c1.close()
+    await c2.close()
+
+
+async def test_batch_order_preserved(cluster):
+    """Responses must line up with requests across batch sizes
+    (functional_test.go:1638-1686 order-stability contract)."""
+    client = cluster.daemons[0].client()
+    for size in (1, 7, 64, 250):
+        reqs = [
+            req(name="order", key=f"key-{i}", hits=0, limit=100 + i)
+            for i in range(size)
+        ]
+        out = await client.get_rate_limits(reqs)
+        assert len(out) == size
+        for i, r in enumerate(out):
+            assert r.error == ""
+            assert r.limit == 100 + i, f"size={size} idx={i}"
+    await client.close()
+
+
+async def test_batch_too_large_rejected(cluster):
+    import grpc
+
+    client = cluster.daemons[0].client()
+    reqs = [req(key=f"big-{i}") for i in range(1001)]
+    with pytest.raises(grpc.aio.AioRpcError) as exc:
+        await client.get_rate_limits(reqs)
+    assert exc.value.code() == grpc.StatusCode.OUT_OF_RANGE
+    await client.close()
+
+
+async def test_missing_fields(cluster):
+    """Per-item validation errors; RPC still succeeds
+    (functional_test.go:896 missing-field table)."""
+    client = cluster.daemons[0].client()
+    out = await client.get_rate_limits(
+        [
+            RateLimitRequest(name="test", unique_key="", hits=1, limit=10,
+                             duration=1000),
+            RateLimitRequest(name="", unique_key="akey", hits=1, limit=10,
+                             duration=1000),
+            req(key="ok"),
+        ]
+    )
+    assert "unique_key" in out[0].error
+    assert "namespace" in out[1].error
+    assert out[2].error == ""
+    await client.close()
+
+
+async def test_health_check(cluster):
+    client = cluster.daemons[0].client()
+    h = await client.health_check()
+    assert h.status == "healthy"
+    assert h.peer_count == 3
+    await client.close()
+
+
+async def test_leaky_bucket_over_grpc(cluster):
+    client = cluster.daemons[0].client()
+    out = await client.get_rate_limits(
+        [req(name="leaky", key="lk", hits=5, limit=10, duration=10_000,
+             algorithm=Algorithm.LEAKY_BUCKET)]
+    )
+    assert out[0].error == ""
+    assert out[0].remaining == 5
+    await client.close()
+
+
+async def test_global_behavior_reconciles():
+    """GLOBAL: non-owner answers locally; hits flow to the owner and the
+    owner broadcasts authoritative state back (global.go protocol)."""
+    behaviors = BehaviorConfig(global_sync_wait=0.05, batch_wait=0.002)
+    c = await Cluster.start(3, behaviors=behaviors)
+    try:
+        name, key = "global", "gk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        client = non_owner.client()
+        g = req(name=name, key=key, hits=2, limit=100,
+                behavior=Behavior.GLOBAL)
+        out = await client.get_rate_limits([g])
+        assert out[0].error == ""
+        assert out[0].remaining == 98  # local answer
+        assert out[0].metadata.get("owner") == owner.conf.grpc_listen_address
+
+        # Wait for hit forwarding + owner broadcast to land.
+        async def owner_saw_hits():
+            while True:
+                o = owner.client()
+                resp = await o.get_rate_limits(
+                    [req(name=name, key=key, hits=0, limit=100,
+                         behavior=Behavior.GLOBAL)]
+                )
+                await o.close()
+                if resp[0].remaining == 98:
+                    return resp[0]
+                await asyncio.sleep(0.02)
+
+        got = await asyncio.wait_for(owner_saw_hits(), timeout=5.0)
+        assert got.remaining == 98
+        await client.close()
+
+        # Broadcast must reach the third daemon (neither owner nor hitter).
+        third = [d for d in c.daemons if d is not owner and d is not non_owner][0]
+
+        async def third_synced():
+            while True:
+                t = third.client()
+                resp = await t.get_rate_limits(
+                    [req(name=name, key=key, hits=0, limit=100,
+                         behavior=Behavior.GLOBAL)]
+                )
+                await t.close()
+                if resp[0].remaining == 98:
+                    return
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(third_synced(), timeout=5.0)
+    finally:
+        await c.stop()
+
+
+async def test_http_gateway_snake_case():
+    """JSON gateway with snake_case fields (daemon.go:245-261 parity)."""
+    import aiohttp
+
+    c = await Cluster.start(1, http_gateway=True)
+    try:
+        addr = c.daemons[0].conf.http_listen_address
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "requests": [
+                    {
+                        "name": "http",
+                        "unique_key": "hk",
+                        "hits": "1",
+                        "limit": "10",
+                        "duration": "60000",
+                    }
+                ]
+            }
+            async with s.post(
+                f"http://{addr}/v1/GetRateLimits", json=body
+            ) as resp:
+                assert resp.status == 200
+                out = await resp.json()
+            item = out["responses"][0]
+            assert item["limit"] == "10"
+            assert item["remaining"] == "9"
+            assert "reset_time" in item
+            async with s.get(f"http://{addr}/v1/HealthCheck") as resp:
+                health = await resp.json()
+            assert health["status"] == "healthy"
+            async with s.get(f"http://{addr}/metrics") as resp:
+                text = await resp.text()
+            assert "gubernator_grpc_request_counts" in text
+            assert "gubernator_cache_size" in text
+    finally:
+        await c.stop()
