@@ -1,0 +1,58 @@
+"""Server entry point: ``python -m gubernator_tpu.cmd.daemon_main``.
+
+The reference's ``cmd/gubernator/main.go``: two flags (``-config``,
+``-debug``), env-first configuration, SIGTERM/SIGINT graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from gubernator_tpu.config import setup_daemon_config
+from gubernator_tpu.transport.daemon import spawn_daemon
+
+
+async def run(config_file: str) -> None:
+    conf = setup_daemon_config(config_file)
+    level = getattr(logging, conf.log_level.upper(), logging.INFO)
+    if conf.log_format == "json":
+        logging.basicConfig(
+            level=level,
+            format='{"time":"%(asctime)s","level":"%(levelname)s",'
+            '"logger":"%(name)s","message":"%(message)s"}',
+        )
+    else:
+        logging.basicConfig(level=level)
+    daemon = await spawn_daemon(conf)
+    print("Ready", flush=True)  # readiness marker (client tests wait on it)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await daemon.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="gubernator-tpu rate-limit daemon")
+    p.add_argument("-config", "--config", default="", help="path to a key=value config file")
+    p.add_argument("-debug", "--debug", action="store_true", help="debug logging")
+    args = p.parse_args(argv)
+    if args.debug:
+        import os
+
+        os.environ["GUBER_LOG_LEVEL"] = "debug"
+    try:
+        asyncio.run(run(args.config))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

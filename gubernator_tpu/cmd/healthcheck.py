@@ -1,0 +1,33 @@
+"""Container healthcheck probe: ``python -m gubernator_tpu.cmd.healthcheck``.
+
+The reference's ``cmd/healthcheck/main.go``: GET /v1/HealthCheck on the
+local daemon, exit 2 unless it reports healthy — suitable as a container
+HEALTHCHECK command.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+
+def main(argv=None) -> int:
+    addr = os.environ.get("GUBER_HTTP_ADDRESS", "localhost:80")
+    url = f"http://{addr}/v1/HealthCheck"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+    except Exception as e:
+        print(f"healthcheck failed: {e}", file=sys.stderr)
+        return 2
+    if body.get("status") != "healthy":
+        print(f"unhealthy: {body.get('message', '')}", file=sys.stderr)
+        return 2
+    print("healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; the host runtime around it — here, the
+key→slot table that front-ends every device tick — is C++ (built by the
+Makefile in this directory).  Import degrades gracefully: when the shared
+library is absent and can't be built, callers fall back to the pure-Python
+SlotMap.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("gubernator.native")
+
+_DIR = os.path.dirname(__file__)
+_SO = os.path.join(_DIR, "libguber_slotmap.so")
+_lib: Optional[ctypes.CDLL] = None
+_build_attempted = False
+
+
+def _try_build() -> None:
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception as e:  # no toolchain / read-only install: fall back
+        log.debug("native slotmap build failed: %s", e)
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The slotmap shared library, building it on first use if needed."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        _try_build()
+    if not os.path.exists(_SO):
+        return None
+    lib = ctypes.CDLL(_SO)
+    lib.guber_slotmap_new.restype = ctypes.c_void_p
+    lib.guber_slotmap_new.argtypes = [ctypes.c_int64]
+    lib.guber_slotmap_free.argtypes = [ctypes.c_void_p]
+    lib.guber_slotmap_get.restype = ctypes.c_int64
+    lib.guber_slotmap_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.guber_slotmap_assign.restype = ctypes.c_int64
+    lib.guber_slotmap_assign.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.guber_slotmap_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.guber_slotmap_size.restype = ctypes.c_int64
+    lib.guber_slotmap_size.argtypes = [ctypes.c_void_p]
+    lib.guber_slotmap_key_of.restype = ctypes.c_int64
+    lib.guber_slotmap_key_of.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.guber_slotmap_resolve_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+    ]
+    lib.guber_slotmap_mapped.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+    ]
+    _lib = lib
+    return lib
+
+
+class NativeSlotMap:
+    """ctypes wrapper mirroring ops.engine.SlotMap, plus batch resolve."""
+
+    def __init__(self, capacity: int):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native slotmap library unavailable")
+        self._lib = lib
+        self.capacity = int(capacity)
+        self._h = lib.guber_slotmap_new(self.capacity)
+        self._keybuf = ctypes.create_string_buffer(4096)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.guber_slotmap_free(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return self._lib.guber_slotmap_size(self._h)
+
+    def get(self, key: str) -> Optional[int]:
+        b = key.encode()
+        s = self._lib.guber_slotmap_get(self._h, b, len(b))
+        return None if s < 0 else s
+
+    def assign(self, key: str) -> Optional[int]:
+        b = key.encode()
+        s = self._lib.guber_slotmap_assign(self._h, b, len(b))
+        return None if s < 0 else s
+
+    def release(self, slot: int) -> None:
+        self._lib.guber_slotmap_release(self._h, slot)
+
+    def key_of(self, slot: int) -> Optional[str]:
+        n = self._lib.guber_slotmap_key_of(
+            self._h, slot, self._keybuf, len(self._keybuf)
+        )
+        return None if n < 0 else self._keybuf.raw[:n].decode()
+
+    def mapped_mask(self) -> np.ndarray:
+        """Boolean array over slots: True where a key is assigned."""
+        out = np.empty(self.capacity, np.uint8)
+        self._lib.guber_slotmap_mapped(self._h, out)
+        return out.astype(bool)
+
+    def resolve_batch(self, keys: List[bytes]):
+        """(slots, known) for a batch of keys in one native call; slot -1
+        means the table is full for that key."""
+        n = len(keys)
+        blob = b"".join(keys)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        slots = np.empty(n, np.int64)
+        known = np.empty(n, np.uint8)
+        self._lib.guber_slotmap_resolve_batch(
+            self._h, blob, offsets, n, slots, known
+        )
+        return slots, known

@@ -252,6 +252,77 @@ def make_install_fn():
     return install
 
 
+# Field order for full-state restore/readback matrices (Store hooks).
+ITEM_INT_ROWS = (
+    "slot", "algorithm", "limit", "remaining", "duration", "created_at",
+    "updated_at", "burst", "status", "expire_at", "valid",
+)
+
+
+def make_restore_fn():
+    """Jitted scatter installing *full* item state — the read-through path
+    (Store.Get on cache miss, reference algorithms.go:45-51) and the
+    Loader.Load restore.  ``ints`` is (11, B) int64 per ITEM_INT_ROWS;
+    ``floats`` is (B,) float64 (leaky ``remaining_f``)."""
+
+    def restore(state: BucketState, ints: jnp.ndarray, floats: jnp.ndarray) -> BucketState:
+        f = dict(zip(ITEM_INT_ROWS, ints))
+        scat = jnp.where(f["valid"] != 0, f["slot"], jnp.int64(1) << 40)
+
+        def put(tbl, upd):
+            return tbl.at[scat].set(upd, mode="drop")
+
+        return BucketState(
+            algorithm=put(state.algorithm, f["algorithm"].astype(jnp.int32)),
+            limit=put(state.limit, f["limit"]),
+            remaining=put(state.remaining, f["remaining"]),
+            remaining_f=put(state.remaining_f, floats),
+            duration=put(state.duration, f["duration"]),
+            created_at=put(state.created_at, f["created_at"]),
+            updated_at=put(state.updated_at, f["updated_at"]),
+            burst=put(state.burst, f["burst"]),
+            status=put(state.status, f["status"].astype(jnp.int32)),
+            expire_at=put(state.expire_at, f["expire_at"]),
+            in_use=put(state.in_use, f["valid"] != 0),
+        )
+
+    return restore
+
+
+def make_readback_fn():
+    """Jitted gather of full item state at given slots — the write-through
+    path (Store.OnChange after every mutation, algorithms.go:149-153).
+    Returns ((10, B) int64, (B,) float64); out-of-range slots read zeros."""
+
+    def readback(state: BucketState, slots: jnp.ndarray):
+        def g(tbl):
+            return tbl.at[slots].get(mode="fill", fill_value=0)
+
+        ints = jnp.stack(
+            [
+                g(state.algorithm).astype(jnp.int64),
+                g(state.limit),
+                g(state.remaining),
+                g(state.duration),
+                g(state.created_at),
+                g(state.updated_at),
+                g(state.burst),
+                g(state.status).astype(jnp.int64),
+                g(state.expire_at),
+                g(state.in_use).astype(jnp.int64),
+            ]
+        )
+        return ints, g(state.remaining_f)
+
+    return readback
+
+
+READBACK_ROWS = (
+    "algorithm", "limit", "remaining", "duration", "created_at",
+    "updated_at", "burst", "status", "expire_at", "in_use",
+)
+
+
 def make_evict_fn():
     """Jitted slot eviction: mark a batch of slots unused (LRU reclamation)."""
 
@@ -279,6 +350,16 @@ def _jitted_evict():
 @functools.lru_cache(maxsize=None)
 def _jitted_install():
     return jax.jit(make_install_fn(), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_restore():
+    return jax.jit(make_restore_fn(), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_readback():
+    return jax.jit(make_readback_fn())
 
 
 class SlotMap:
@@ -322,6 +403,42 @@ class SlotMap:
     def key_of(self, slot: int) -> Optional[str]:
         return self._keys[slot]
 
+    def mapped_mask(self) -> np.ndarray:
+        """Boolean array over slots: True where a key is assigned."""
+        return np.fromiter(
+            (k is not None for k in self._keys), np.bool_, count=self.capacity
+        )
+
+    def resolve_batch(self, keys: List[bytes]):
+        """(slots, known) for a batch of keys; slot -1 = table full.
+        Interface-compatible with NativeSlotMap.resolve_batch."""
+        n = len(keys)
+        slots = np.empty(n, np.int64)
+        known = np.empty(n, np.uint8)
+        get = self._map.get
+        for j in range(n):
+            k = keys[j].decode()
+            s = get(k)
+            if s is not None:
+                slots[j] = s
+                known[j] = 1
+            else:
+                s = self.assign(k)
+                slots[j] = -1 if s is None else s
+                known[j] = 0
+        return slots, known
+
+
+def make_slot_map(capacity: int):
+    """Native C++ slotmap when the shared library is available (built by
+    gubernator_tpu/native/Makefile), pure-Python fallback otherwise."""
+    try:
+        from gubernator_tpu.native import NativeSlotMap
+
+        return NativeSlotMap(capacity)
+    except Exception:
+        return SlotMap(capacity)
+
 
 class TickEngine:
     """Owns the device state table and applies request batches tick by tick.
@@ -335,9 +452,14 @@ class TickEngine:
         capacity: int = 1 << 16,
         max_batch: int = 4096,
         device: Optional[jax.Device] = None,
+        store=None,
     ):
         self.capacity = int(capacity)
         self.max_batch = int(max_batch)
+        # Optional write/read-through Store (reference store.go:49-65).
+        # Write-through costs one extra D2H readback of touched slots per
+        # tick; read-through one extra scatter when misses hit the store.
+        self.store = store
         self.device = device or jax.devices()[0]
         with jax.default_device(self.device):
             self.state: BucketState = jax.tree.map(
@@ -346,7 +468,9 @@ class TickEngine:
         self._tick = _jitted_tick(self.capacity)
         self._evict = _jitted_evict()
         self._install = _jitted_install()
-        self.slots = SlotMap(self.capacity)
+        self._restore = _jitted_restore()
+        self._readback = _jitted_readback()
+        self.slots = make_slot_map(self.capacity)
         self._last_access = np.zeros(self.capacity, np.int64)
         # Slots assigned host-side but not yet written by a device tick; the
         # device's in_use lags for these, so reclamation must not treat them
@@ -396,7 +520,7 @@ class TickEngine:
         want = want or max(1, self.capacity // 16)
         in_use = np.asarray(self.state.in_use)
         expire = np.asarray(self.state.expire_at)
-        mapped = np.array([k is not None for k in self.slots._keys])
+        mapped = self.slots.mapped_mask()
         # Slots assigned since the last tick look un-used on device; they are
         # live, not dead.
         if self._pending:
@@ -437,22 +561,104 @@ class TickEngine:
             raise ValueError(f"batch of {n} exceeds engine max {self.max_batch}")
         b = self.max_batch
         m = np.zeros((len(REQ_ROWS), b), np.int64)
-        m[REQ_ROW_INDEX["slot"]] = self.capacity  # padding scatters out of bounds
+        R = REQ_ROW_INDEX
+        m[R["slot"]] = self.capacity  # padding scatters out of bounds
         errors: Dict[int, str] = {}
-        for i, r in enumerate(requests):
+
+        # Gregorian resolution (host-side calendar math) — only requests
+        # carrying the flag pay for it; failures become per-item errors.
+        greg_idx = [
+            i for i, r in enumerate(requests)
+            if r.behavior & Behavior.DURATION_IS_GREGORIAN
+        ]
+        for i in greg_idx:
             try:
-                greg_exp, greg_dur = resolve_gregorian(r, now)
-            except timeutil.GregorianError as e:
-                errors[i] = str(e)
-                continue
-            key = r.hash_key()
-            slot, known = self._resolve_slot(key, now)
-            self._last_access[slot] = self._tick_count
-            pack_request_col(
-                m, i, r, slot=slot, known=known, now=now,
-                greg_exp=greg_exp, greg_dur=greg_dur,
-            )
+                e, d = resolve_gregorian(requests[i], now)
+                m[R["greg_exp"], i] = e
+                m[R["greg_dur"], i] = d
+            except timeutil.GregorianError as exc:
+                errors[i] = str(exc)
+
+        if errors:
+            sel = np.array([i for i in range(n) if i not in errors], np.int64)
+        else:
+            sel = np.arange(n, dtype=np.int64)
+        if len(sel) == 0:
+            return m, n, errors
+
+        # One native call resolves every key to a slot (the reference does a
+        # per-key map lookup inside each worker goroutine; here it's a batch
+        # against the C++ open-addressing table).
+        keys = [requests[i].hash_key().encode() for i in sel]
+        slots, known = self.slots.resolve_batch(keys)
+        if (slots < 0).any():
+            self._reclaim(now)
+            retry = np.flatnonzero(slots < 0)
+            s2, k2 = self.slots.resolve_batch([keys[j] for j in retry])
+            slots[retry] = s2
+            known[retry] = k2
+            if (slots < 0).any():
+                raise RuntimeError("rate-limit table full; eviction failed")
+        self._last_access[slots] = self._tick_count
+        miss = known == 0
+        self._pending.update(slots[miss].tolist())
+        self.metric_hits += int((~miss).sum())
+        self.metric_misses += int(miss.sum())
+
+        if self.store is not None and miss.any():
+            self._read_through(requests, sel, slots, known, miss)
+
+        # Column-wise packing: one pass per field instead of 12 scalar
+        # writes per request.
+        m[R["slot"], sel] = slots
+        m[R["known"], sel] = known
+        m[R["hits"], sel] = [requests[i].hits for i in sel]
+        m[R["limit"], sel] = [requests[i].limit for i in sel]
+        m[R["duration"], sel] = [requests[i].duration for i in sel]
+        m[R["algorithm"], sel] = [int(requests[i].algorithm) for i in sel]
+        m[R["behavior"], sel] = [int(requests[i].behavior) for i in sel]
+        m[R["created_at"], sel] = [
+            requests[i].created_at if requests[i].created_at is not None else now
+            for i in sel
+        ]
+        m[R["burst"], sel] = [requests[i].burst for i in sel]
+        m[R["valid"], sel] = 1
         return m, n, errors
+
+    def _read_through(self, requests, sel, slots, known, miss) -> None:
+        """Store.Get for cache misses (algorithms.go:45-51): install the
+        persisted items so the kernel sees existing buckets."""
+        restore_rows: List[tuple] = []
+        restored: set = set()
+        for j in np.flatnonzero(miss):
+            slot = int(slots[j])
+            if slot in restored:
+                known[j] = 1
+                continue
+            item = self.store.get(requests[sel[j]])
+            if item is None:
+                continue
+            restored.add(slot)
+            known[j] = 1
+            self._pending.discard(slot)
+            restore_rows.append(
+                (
+                    (slot, item["algorithm"], item["limit"], item["remaining"],
+                     item["duration"], item["created_at"], item["updated_at"],
+                     item["burst"], item["status"], item["expire_at"], 1),
+                    item.get("remaining_f", 0.0),
+                )
+            )
+        if restore_rows:
+            w = pad_pow2(len(restore_rows))
+            ints = np.zeros((len(ITEM_INT_ROWS), w), np.int64)
+            floats = np.zeros(w, np.float64)
+            for j, (row, rf) in enumerate(restore_rows):
+                ints[:, j] = row
+                floats[j] = rf
+            self.state = self._restore(
+                self.state, jnp.asarray(ints), jnp.asarray(floats)
+            )
 
     # ------------------------------------------------------------------
     # The tick
@@ -477,6 +683,8 @@ class TickEngine:
                 rm = np.asarray(resp)  # one D2H: (5, B) int64
                 status, limit, remaining, reset, over = rm[:, :n]
                 self.metric_over_limit += int(over.sum())
+                if self.store is not None:
+                    self._write_through(chunk, packed, n, errors)
                 out.extend(
                     RateLimitResponse(error=errors[i])
                     if i in errors
@@ -489,6 +697,50 @@ class TickEngine:
                     for i in range(n)
                 )
         return out
+
+    def _write_through(
+        self, requests: Sequence[RateLimitRequest], packed: np.ndarray,
+        n: int, errors: Dict[int, str],
+    ) -> None:
+        """Store.OnChange with each touched slot's post-tick state
+        (write-through, algorithms.go:149-153).  A slot cleared by the tick
+        (RESET_REMAINING removal) maps to Store.remove instead, matching the
+        reference's remove-on-reset (algorithms.go:78-90)."""
+        slots = packed[REQ_ROW_INDEX["slot"], :n]
+        ints, floats = self._readback(self.state, jnp.asarray(slots))
+        ints = np.asarray(ints)
+        floats = np.asarray(floats)
+        seen: set = set()
+        for i in range(n):
+            if i in errors:
+                continue
+            slot = int(slots[i])
+            if slot in seen:
+                continue  # duplicate key in batch: one OnChange, final state
+            seen.add(slot)
+            key = self.slots.key_of(slot)
+            if key is None:
+                continue
+            f = dict(zip(READBACK_ROWS, ints[:, i]))
+            if not f["in_use"]:
+                self.store.remove(key)
+                continue
+            self.store.on_change(
+                requests[i],
+                {
+                    "key": key,
+                    "algorithm": int(f["algorithm"]),
+                    "limit": int(f["limit"]),
+                    "remaining": int(f["remaining"]),
+                    "remaining_f": float(floats[i]),
+                    "duration": int(f["duration"]),
+                    "created_at": int(f["created_at"]),
+                    "updated_at": int(f["updated_at"]),
+                    "burst": int(f["burst"]),
+                    "status": int(f["status"]),
+                    "expire_at": int(f["expire_at"]),
+                },
+            )
 
     def install_globals(
         self, updates: Sequence[GlobalUpdate], now: Optional[int] = None

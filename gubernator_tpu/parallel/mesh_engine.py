@@ -96,7 +96,7 @@ class MeshTickEngine:
         local_capacity: int = 1 << 14,
         max_batch: int = 1024,
     ):
-        from gubernator_tpu.ops.engine import SlotMap
+        from gubernator_tpu.ops.engine import make_slot_map
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.devices.size
@@ -122,7 +122,7 @@ class MeshTickEngine:
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
         # (workers.go:180-184).
-        self.slots = [SlotMap(self.local_capacity) for _ in range(self.n_shards)]
+        self.slots = [make_slot_map(self.local_capacity) for _ in range(self.n_shards)]
         self._last_access = np.zeros(self.capacity, np.int64)
         # Global slots assigned host-side but not yet written by a device
         # tick; device in_use/expire_at lag for these, so reclamation must
@@ -179,7 +179,7 @@ class MeshTickEngine:
         lo = shard * self.local_capacity
         expire = np.asarray(self.state.expire_at[lo : lo + self.local_capacity])
         in_use = np.asarray(self.state.in_use[lo : lo + self.local_capacity])
-        mapped = np.array([k is not None for k in sm._keys])
+        mapped = sm.mapped_mask()
         if self._pending:
             pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
             if pend:

@@ -109,7 +109,11 @@ def _make_engine(conf: InstanceConfig):
         )
     from gubernator_tpu.ops.engine import TickEngine
 
-    return TickEngine(capacity=conf.cache_size, max_batch=conf.tpu_max_batch)
+    return TickEngine(
+        capacity=conf.cache_size,
+        max_batch=conf.tpu_max_batch,
+        store=conf.store,
+    )
 
 
 class V1Instance:

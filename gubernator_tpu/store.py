@@ -1,0 +1,116 @@
+"""Persistence hooks: Store (write/read-through) and Loader (snapshot).
+
+Mirrors the reference's interface-driven persistence (``store.go:21-78``):
+
+* :class:`Store` — continuous write-through: ``on_change`` fires after every
+  bucket mutation with the full item state (algorithms.go:149-153 call
+  sites); ``get`` is consulted on cache miss (read-through,
+  algorithms.go:45-51); ``remove`` on eviction.
+* :class:`Loader` — one-shot: ``load()`` streams items into the engine at
+  startup (workers.go:329-413), ``save(items)`` drains the table at
+  shutdown (workers.go:451-534).
+
+Items are plain dicts with the engine's SoA field names::
+
+    {key, algorithm, limit, remaining, remaining_f, duration,
+     created_at, updated_at, burst, status, expire_at}
+
+(the union of the reference's ``TokenBucketItem``/``LeakyBucketItem`` +
+``CacheItem``, store.go:29-43 / cache.go:29-41).
+
+No store implementation ships beyond mocks and a JSONL file loader —
+persistence is the embedding user's job, as in the reference (README
+"Optional Disk Persistence").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Protocol
+
+from gubernator_tpu.types import RateLimitRequest
+
+
+class Store(Protocol):
+    """Write-through/read-through hooks (reference store.go:49-65)."""
+
+    def on_change(self, req: RateLimitRequest, item: dict) -> None:
+        """Called after every mutation with the full bucket state."""
+
+    def get(self, req: RateLimitRequest) -> Optional[dict]:
+        """Called on cache miss; return the persisted item or None."""
+
+    def remove(self, key: str) -> None:
+        """Called when an item is evicted from the cache."""
+
+
+class Loader(Protocol):
+    """Startup/shutdown snapshot hooks (reference store.go:69-78)."""
+
+    def load(self) -> Iterable[dict]: ...
+
+    def save(self, items: Iterable[dict]) -> None: ...
+
+
+class MockStore:
+    """Dict-backed Store (reference MockStore, store.go:80-112)."""
+
+    def __init__(self):
+        self.data: Dict[str, dict] = {}
+        self.called = {"OnChange()": 0, "Get()": 0, "Remove()": 0}
+
+    def on_change(self, req: RateLimitRequest, item: dict) -> None:
+        self.called["OnChange()"] += 1
+        self.data[item["key"]] = dict(item)
+
+    def get(self, req: RateLimitRequest) -> Optional[dict]:
+        self.called["Get()"] += 1
+        item = self.data.get(req.hash_key())
+        return dict(item) if item is not None else None
+
+    def remove(self, key: str) -> None:
+        self.called["Remove()"] += 1
+        self.data.pop(key, None)
+
+
+class MockLoader:
+    """List-backed Loader (reference MockLoader, store.go:114-150)."""
+
+    def __init__(self, items: Optional[List[dict]] = None):
+        self.contents: List[dict] = list(items or [])
+        self.called = {"Load()": 0, "Save()": 0}
+
+    def load(self) -> Iterable[dict]:
+        self.called["Load()"] += 1
+        return list(self.contents)
+
+    def save(self, items: Iterable[dict]) -> None:
+        self.called["Save()"] += 1
+        self.contents = list(items)
+
+
+class FileLoader:
+    """JSONL snapshot-to-disk Loader (orbax-style host snapshot of the
+    device table; the simplest durable Loader)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Iterable[dict]:
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    def save(self, items: Iterable[dict]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for it in items:
+                f.write(json.dumps(it) + "\n")
+        os.replace(tmp, self.path)

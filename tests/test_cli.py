@@ -1,0 +1,69 @@
+"""CLI smoke tests (reference cmd/gubernator/main_test.go:26 pattern):
+spawn the daemon binary, wait for "Ready", probe it, shut down cleanly."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    # Hermetic: no tunneled-TPU plugin, cpu platform, tiny engine.
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(
+        GUBER_GRPC_ADDRESS="127.0.0.1:19981",
+        GUBER_HTTP_ADDRESS="127.0.0.1:19980",
+        GUBER_CACHE_SIZE="1024",
+        GUBER_TPU_MAX_BATCH="128",
+        GUBER_PEER_DISCOVERY_TYPE="none",
+    )
+    env.update(extra)
+    return env
+
+
+@pytest.mark.slow
+def test_daemon_main_boots_and_serves():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.daemon_main"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=_env(),
+        text=True,
+    )
+    try:
+        # Wait for the readiness marker (compile happens at startup).
+        deadline = time.time() + 120
+        line = ""
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "Ready" in line:
+                break
+            assert proc.poll() is None, proc.stderr.read()
+        assert "Ready" in line
+
+        with urllib.request.urlopen(
+            "http://127.0.0.1:19980/v1/HealthCheck", timeout=5
+        ) as resp:
+            assert b"healthy" in resp.read()
+
+        # The healthcheck probe binary exits 0 against a healthy daemon.
+        probe = subprocess.run(
+            [sys.executable, "-m", "gubernator_tpu.cmd.healthcheck"],
+            env=_env(GUBER_HTTP_ADDRESS="127.0.0.1:19980"),
+            capture_output=True,
+            timeout=30,
+        )
+        assert probe.returncode == 0, probe.stderr
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

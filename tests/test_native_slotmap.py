@@ -1,0 +1,84 @@
+"""Native C++ slotmap: behavior parity against the pure-Python SlotMap."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import SlotMap
+
+native = pytest.importorskip("gubernator_tpu.native")
+if native.load_library() is None:
+    pytest.skip("native slotmap library unavailable", allow_module_level=True)
+
+from gubernator_tpu.native import NativeSlotMap  # noqa: E402
+
+
+@pytest.fixture(params=["python", "native"])
+def sm(request):
+    if request.param == "python":
+        return SlotMap(256)
+    return NativeSlotMap(256)
+
+
+def test_assign_get_release_roundtrip(sm):
+    s = sm.assign("a")
+    assert s is not None
+    assert sm.get("a") == s
+    assert sm.assign("a") == s  # idempotent
+    assert sm.key_of(s) == "a"
+    assert len(sm) == 1
+    sm.release(s)
+    assert sm.get("a") is None
+    assert sm.key_of(s) is None
+    assert len(sm) == 0
+
+
+def test_fills_to_capacity_and_reuses_released(sm):
+    slots = [sm.assign(f"k{i}") for i in range(256)]
+    assert None not in slots
+    assert len(set(slots)) == 256
+    assert sm.assign("overflow") is None
+    sm.release(sm.get("k0"))
+    assert sm.assign("overflow") is not None
+
+
+def test_mapped_mask(sm):
+    for i in range(10):
+        sm.assign(f"k{i}")
+    mask = sm.mapped_mask()
+    assert mask.sum() == 10
+    sm.release(sm.get("k0"))
+    assert sm.mapped_mask().sum() == 9
+
+
+def test_resolve_batch_matches_single_ops(sm):
+    keys = [f"batch-{i % 50}".encode() for i in range(100)]
+    slots, known = sm.resolve_batch(keys)
+    assert (slots >= 0).all()
+    # First 50 are fresh, second 50 are repeats mapping to the same slots.
+    assert known[:50].sum() == 0
+    assert known[50:].sum() == 50
+    assert (slots[:50] == slots[50:]).all()
+    for i in range(50):
+        assert sm.get(f"batch-{i}") == slots[i]
+
+
+def test_resolve_batch_full_table_returns_minus_one(sm):
+    keys = [f"full-{i}".encode() for i in range(300)]
+    slots, known = sm.resolve_batch(keys)
+    assert (slots[:256] >= 0).all()
+    assert (slots[256:] == -1).all()
+
+
+def test_native_tombstone_rehash_stays_correct():
+    """Churn far past capacity to exercise tombstone cleanup."""
+    sm = NativeSlotMap(64)
+    for round_ in range(200):
+        keys = [f"r{round_}-{i}" for i in range(64)]
+        for k in keys:
+            assert sm.assign(k) is not None
+        assert len(sm) == 64
+        for k in keys:
+            s = sm.get(k)
+            assert s is not None and sm.key_of(s) == k
+            sm.release(s)
+        assert len(sm) == 0

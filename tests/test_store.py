@@ -1,0 +1,103 @@
+"""Store/Loader persistence hook tests.
+
+Modeled on the reference's store_test.go: TestLoader (:76) proves load-at-
+startup / save-at-shutdown; TestStore (:127) proves read-through on miss
+and write-through on every mutation.
+"""
+
+import pytest
+
+from gubernator_tpu.ops.engine import TickEngine
+from gubernator_tpu.store import FileLoader, MockLoader, MockStore
+from gubernator_tpu.types import Algorithm, RateLimitRequest, Status
+
+NOW = 1_700_000_000_000
+
+
+def req(key="k", hits=1, limit=5, duration=60_000, **kw):
+    return RateLimitRequest(
+        name="store_test", unique_key=key, hits=hits, limit=limit,
+        duration=duration, **kw,
+    )
+
+
+def test_store_write_through_and_read_through():
+    store = MockStore()
+    eng = TickEngine(capacity=256, max_batch=64, store=store)
+    out = eng.process([req(hits=2)], now=NOW)[0]
+    assert out.remaining == 3
+    assert store.called["Get()"] == 1  # miss consults the store
+    # Write-through fired with the post-tick state.
+    assert store.called["OnChange()"] == 1
+    item = store.data["store_test_k"]
+    assert item["remaining"] == 3
+    assert item["algorithm"] == Algorithm.TOKEN_BUCKET
+    assert item["expire_at"] == NOW + 60_000
+
+    # Fresh engine, same store: miss reads through and continues the bucket.
+    eng2 = TickEngine(capacity=256, max_batch=64, store=store)
+    out = eng2.process([req(hits=1)], now=NOW + 1)[0]
+    assert store.called["Get()"] == 2
+    assert out.remaining == 2  # 5 - 2 (persisted) - 1
+
+    # Unknown key: store consulted, returns None, new bucket.
+    out = eng2.process([req(key="other", hits=1)], now=NOW + 1)[0]
+    assert out.remaining == 4
+    assert store.called["Get()"] == 3
+
+
+def test_store_leaky_read_through_preserves_float_remaining():
+    store = MockStore()
+    eng = TickEngine(capacity=256, max_batch=64, store=store)
+    eng.process(
+        [req(hits=3, limit=10, duration=10_000,
+             algorithm=Algorithm.LEAKY_BUCKET)],
+        now=NOW,
+    )
+    item = store.data["store_test_k"]
+    assert item["remaining_f"] == 7.0
+    eng2 = TickEngine(capacity=256, max_batch=64, store=store)
+    out = eng2.process(
+        [req(hits=0, limit=10, duration=10_000,
+             algorithm=Algorithm.LEAKY_BUCKET)],
+        now=NOW,
+    )[0]
+    assert out.remaining == 7
+
+
+def test_loader_roundtrip(tmp_path):
+    loader = MockLoader()
+    eng = TickEngine(capacity=256, max_batch=64)
+    eng.process([req(hits=2), req(key="k2", hits=1, limit=9)], now=NOW)
+    loader.save(eng.export_items())
+    assert loader.called["Save()"] == 1
+    assert len(loader.contents) == 2
+
+    eng2 = TickEngine(capacity=256, max_batch=64)
+    eng2.load_items(list(loader.load()), now=NOW)
+    out = eng2.process([req(hits=0)], now=NOW)[0]
+    assert out.remaining == 3
+    out = eng2.process([req(key="k2", hits=0, limit=9)], now=NOW)[0]
+    assert out.remaining == 8
+
+
+def test_file_loader(tmp_path):
+    path = str(tmp_path / "snapshot.jsonl")
+    loader = FileLoader(path)
+    eng = TickEngine(capacity=256, max_batch=64)
+    eng.process([req(hits=4)], now=NOW)
+    loader.save(eng.export_items())
+
+    eng2 = TickEngine(capacity=256, max_batch=64)
+    eng2.load_items(list(loader.load()), now=NOW)
+    out = eng2.process([req(hits=0)], now=NOW)[0]
+    assert out.remaining == 1
+
+
+def test_loader_drops_expired_items():
+    eng = TickEngine(capacity=256, max_batch=64)
+    eng.process([req(hits=1, duration=1000)], now=NOW)
+    items = eng.export_items()
+    eng2 = TickEngine(capacity=256, max_batch=64)
+    eng2.load_items(items, now=NOW + 10_000)  # past expire_at
+    assert eng2.cache_size() == 0
