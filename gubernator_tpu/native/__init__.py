@@ -77,6 +77,27 @@ def load_library() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p,
         np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
     ]
+    lib.guber_slotmap_release_batch.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ]
+    lib.guber_slotmap_keys_batch.restype = ctypes.c_int64
+    lib.guber_slotmap_keys_batch.argtypes = [
+        ctypes.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.guber_slotmap_assign_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
     _lib = lib
     return lib
 
@@ -140,3 +161,35 @@ class NativeSlotMap:
             self._h, blob, offsets, n, slots, known
         )
         return slots, known
+
+    def release_batch(self, slots: np.ndarray) -> None:
+        """Release a batch of slots in one native call."""
+        slots = np.ascontiguousarray(slots, np.int64)
+        self._lib.guber_slotmap_release_batch(self._h, slots, len(slots))
+
+    def keys_batch(self, slots: np.ndarray) -> List[bytes]:
+        """Keys of a batch of slots (b"" for unassigned) in one native call."""
+        slots = np.ascontiguousarray(slots, np.int64)
+        n = len(slots)
+        offsets = np.zeros(n + 1, np.int64)
+        cap = max(4096, n * 64)
+        while True:
+            blob = ctypes.create_string_buffer(cap)
+            need = self._lib.guber_slotmap_keys_batch(
+                self._h, slots, n, blob, cap, offsets
+            )
+            if need <= cap:
+                break
+            cap = int(need)
+        mv = memoryview(blob)  # slice without copying the whole buffer
+        return [bytes(mv[offsets[i] : offsets[i + 1]]) for i in range(n)]
+
+    def assign_batch(self, keys: List[bytes]) -> np.ndarray:
+        """Assign a batch of keys in one native call; -1 = table full."""
+        n = len(keys)
+        blob = b"".join(keys)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(k) for k in keys], out=offsets[1:])
+        out = np.empty(n, np.int64)
+        self._lib.guber_slotmap_assign_batch(self._h, blob, offsets, n, out)
+        return out

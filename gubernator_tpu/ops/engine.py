@@ -227,7 +227,10 @@ def make_install_fn():
     def install(state: BucketState, cols: jnp.ndarray, now: jnp.ndarray) -> BucketState:
         slot, algo, limit, remaining, status, duration, reset_time, valid = cols
         is_token = algo == jnp.int64(0)
-        scat = jnp.where(valid != 0, slot, jnp.int64(1) << 40)  # invalid rows drop
+        # Invalid rows aim one past the table and drop.  The sentinel must
+        # stay < 2^31: GSPMD partitions the scatter with int32 index math,
+        # and a 2^40 sentinel truncates to slot 0 on a sharded table.
+        scat = jnp.where(valid != 0, slot, jnp.int64(state.limit.shape[0]))
 
         def put(tbl, upd):
             return tbl.at[scat].set(upd, mode="drop")
@@ -267,7 +270,10 @@ def make_restore_fn():
 
     def restore(state: BucketState, ints: jnp.ndarray, floats: jnp.ndarray) -> BucketState:
         f = dict(zip(ITEM_INT_ROWS, ints))
-        scat = jnp.where(f["valid"] != 0, f["slot"], jnp.int64(1) << 40)
+        # Sentinel must stay < 2^31 (see make_install_fn).
+        scat = jnp.where(
+            f["valid"] != 0, f["slot"], jnp.int64(state.limit.shape[0])
+        )
 
         def put(tbl, upd):
             return tbl.at[scat].set(upd, mode="drop")
@@ -321,6 +327,61 @@ READBACK_ROWS = (
     "algorithm", "limit", "remaining", "duration", "created_at",
     "updated_at", "burst", "status", "expire_at", "in_use",
 )
+
+
+def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
+    """Build Loader-contract item dicts for the live slots of a (host) state.
+
+    Shared by both engines' ``export_items``: one vectorized slice per
+    column, then the (unavoidable, dict-shaped) per-item build.
+    """
+    cols = {
+        "algorithm": st.algorithm[live],
+        "limit": st.limit[live],
+        "remaining": st.remaining[live],
+        "remaining_f": st.remaining_f[live],
+        "duration": st.duration[live],
+        "created_at": st.created_at[live],
+        "updated_at": st.updated_at[live],
+        "burst": st.burst[live],
+        "status": st.status[live],
+        "expire_at": st.expire_at[live],
+    }
+    return [
+        {
+            "key": keys[j].decode(),
+            "algorithm": int(cols["algorithm"][j]),
+            "limit": int(cols["limit"][j]),
+            "remaining": int(cols["remaining"][j]),
+            "remaining_f": float(cols["remaining_f"][j]),
+            "duration": int(cols["duration"][j]),
+            "created_at": int(cols["created_at"][j]),
+            "updated_at": int(cols["updated_at"][j]),
+            "burst": int(cols["burst"][j]),
+            "status": int(cols["status"][j]),
+            "expire_at": int(cols["expire_at"][j]),
+        }
+        for j in range(len(live))
+    ]
+
+
+def pack_restore_matrix(items: Sequence[dict], ok: np.ndarray, slots: np.ndarray):
+    """Pack snapshot items into the ``make_restore_fn`` input matrices.
+
+    ``ok`` selects the rows of ``items``/``slots`` that got a slot; returns
+    ``(ints, floats)`` padded to a power-of-two width so restore compiles a
+    handful of shapes.
+    """
+    n = len(ok)
+    w = pad_pow2(n)
+    ints = np.zeros((len(ITEM_INT_ROWS), w), np.int64)
+    floats = np.zeros(w, np.float64)
+    ints[0, :n] = slots[ok]
+    for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
+        ints[r, :n] = [items[j][name] for j in ok]
+    ints[-1, :n] = 1  # valid
+    floats[:n] = [items[j]["remaining_f"] for j in ok]
+    return ints, floats
 
 
 def make_evict_fn():
@@ -428,6 +489,23 @@ class SlotMap:
                 known[j] = 0
         return slots, known
 
+    def release_batch(self, slots: np.ndarray) -> None:
+        for s in slots:
+            self.release(int(s))
+
+    def keys_batch(self, slots: np.ndarray) -> List[bytes]:
+        return [
+            (k.encode() if (k := self._keys[int(s)]) is not None else b"")
+            for s in slots
+        ]
+
+    def assign_batch(self, keys: List[bytes]) -> np.ndarray:
+        out = np.empty(len(keys), np.int64)
+        for j, k in enumerate(keys):
+            s = self.assign(k.decode())
+            out[j] = -1 if s is None else s
+        return out
+
 
 def make_slot_map(capacity: int):
     """Native C++ slotmap when the shared library is available (built by
@@ -531,8 +609,7 @@ class TickEngine:
         mapped &= self._last_access != self._tick_count
         dead = mapped & (~in_use | (expire < now))
         freed = np.flatnonzero(dead)
-        for s in freed:
-            self.slots.release(int(s))
+        self.slots.release_batch(freed)
         if len(freed) >= want:
             return
         # LRU: evict the least-recently-touched live slots.
@@ -542,8 +619,7 @@ class TickEngine:
         n = min(want - len(freed), len(live))
         victims = live[np.argsort(self._last_access[live])[:n]]
         self.metric_unexpired_evictions += int(n)
-        for s in victims:
-            self.slots.release(int(s))
+        self.slots.release_batch(victims)
         padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
         padded[: len(victims)] = victims
         self.state = self._evict(self.state, jnp.asarray(padded))
@@ -592,6 +668,14 @@ class TickEngine:
         keys = [requests[i].hash_key().encode() for i in sel]
         slots, known = self.slots.resolve_batch(keys)
         if (slots < 0).any():
+            # Stamp the already-resolved rows live *before* reclaiming:
+            # fresh misses look unused on device and known slots carry a
+            # stale _last_access, so an unstamped reclaim could release
+            # slots resolved microseconds ago and hand them to the retried
+            # keys — two keys sharing one bucket within the same tick.
+            ok = slots >= 0
+            self._last_access[slots[ok]] = self._tick_count
+            self._pending.update(slots[ok & (known == 0)].tolist())
             self._reclaim(now)
             retry = np.flatnonzero(slots < 0)
             s2, k2 = self.slots.resolve_batch([keys[j] for j in retry])
@@ -707,7 +791,12 @@ class TickEngine:
         (RESET_REMAINING removal) maps to Store.remove instead, matching the
         reference's remove-on-reset (algorithms.go:78-90)."""
         slots = packed[REQ_ROW_INDEX["slot"], :n]
-        ints, floats = self._readback(self.state, jnp.asarray(slots))
+        # Pad to a power of two so this per-tick hot path compiles a handful
+        # of widths, not one per batch size; padding slots aim out of range
+        # (fill reads return zeros) and rows past n are never read host-side.
+        padded = np.full(pad_pow2(max(1, n)), self.capacity, np.int64)
+        padded[:n] = slots
+        ints, floats = self._readback(self.state, jnp.asarray(padded))
         ints = np.asarray(ints)
         floats = np.asarray(floats)
         seen: set = set()
@@ -752,6 +841,10 @@ class TickEngine:
             return
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
+            # New logical tick: without this, slots touched by the *previous*
+            # tick still satisfy the "touched this tick" reclaim guard and
+            # LRU eviction can't free anything.
+            self._tick_count += 1
             rows = []
             for u in updates:
                 try:
@@ -776,62 +869,46 @@ class TickEngine:
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
     # ------------------------------------------------------------------
     def export_items(self) -> List[dict]:
-        """Drain live bucket state to host dicts (Loader.Save analog)."""
+        """Drain live bucket state to host dicts (Loader.Save analog).
+
+        One D2H of the table + one native key export + vectorized column
+        slicing; the per-item dict build is the only O(live) Python left
+        (the Loader contract is dict-shaped).
+        """
         with self._lock:
             st = jax.tree.map(np.asarray, self.state)
-            items = []
-            for slot in range(self.capacity):
-                key = self.slots.key_of(slot)
-                if key is None or not st.in_use[slot]:
-                    continue
-                items.append(
-                    {
-                        "key": key,
-                        "algorithm": int(st.algorithm[slot]),
-                        "limit": int(st.limit[slot]),
-                        "remaining": int(st.remaining[slot]),
-                        "remaining_f": float(st.remaining_f[slot]),
-                        "duration": int(st.duration[slot]),
-                        "created_at": int(st.created_at[slot]),
-                        "updated_at": int(st.updated_at[slot]),
-                        "burst": int(st.burst[slot]),
-                        "status": int(st.status[slot]),
-                        "expire_at": int(st.expire_at[slot]),
-                    }
-                )
-            return items
+            live = np.flatnonzero(self.slots.mapped_mask() & st.in_use)
+            if len(live) == 0:
+                return []
+            return items_from_columns(self.slots.keys_batch(live), st, live)
 
     def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
         """Install snapshot items into the table (Loader.Load analog).
 
-        Reclaims space up front and assigns slots directly (no device
-        eviction mid-loop), then writes the whole table once — so a partial
-        snapshot of the device state can't clobber concurrent updates.
+        One native batch-assign + one jitted scatter — no full-table
+        rewrite, so a restore can't clobber concurrent updates and scales
+        to the 10M-slot regime.
         """
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
+            self._tick_count += 1  # see install_globals: unblock LRU reclaim
             live = [it for it in items if it["expire_at"] >= now]
-            if len(self.slots) + len(live) > self.capacity:
-                self._reclaim(now, want=len(live))
-            st = jax.tree.map(np.array, self.state)
-            for it in live:
-                slot = self.slots.assign(it["key"])
-                if slot is None:
-                    break  # table full even after reclaim; drop the tail
-                self._last_access[slot] = self._tick_count
-                st.algorithm[slot] = it["algorithm"]
-                st.limit[slot] = it["limit"]
-                st.remaining[slot] = it["remaining"]
-                st.remaining_f[slot] = it["remaining_f"]
-                st.duration[slot] = it["duration"]
-                st.created_at[slot] = it["created_at"]
-                st.updated_at[slot] = it["updated_at"]
-                st.burst[slot] = it["burst"]
-                st.status[slot] = it["status"]
-                st.expire_at[slot] = it["expire_at"]
-                st.in_use[slot] = True
-            with jax.default_device(self.device):
-                self.state = jax.tree.map(jnp.asarray, st)
+            if not live:
+                return
+            shortfall = len(self.slots) + len(live) - self.capacity
+            if shortfall > 0:
+                self._reclaim(now, want=shortfall)
+            slots = self.slots.assign_batch(
+                [it["key"].encode() for it in live]
+            )
+            ok = np.flatnonzero(slots >= 0)  # full table: drop the tail
+            if len(ok) == 0:
+                return
+            ints, floats = pack_restore_matrix(live, ok, slots)
+            self._last_access[slots[ok]] = self._tick_count
+            self.state = self._restore(
+                self.state, jnp.asarray(ints), jnp.asarray(floats)
+            )
 
     def cache_size(self) -> int:
         return len(self.slots)

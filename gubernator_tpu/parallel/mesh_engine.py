@@ -38,10 +38,13 @@ from gubernator_tpu.ops.buckets import BucketState
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
+    items_from_columns,
     make_evict_fn,
     make_install_fn,
+    make_restore_fn,
     make_tick_fn,
     pack_request_col,
+    pack_restore_matrix,
     pad_pow2,
     resolve_gregorian,
 )
@@ -119,6 +122,7 @@ class MeshTickEngine:
         )
         self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
         self._install = jax.jit(make_install_fn(), donate_argnums=(0,))
+        self._restore = jax.jit(make_restore_fn(), donate_argnums=(0,))
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
         # (workers.go:180-184).
@@ -300,6 +304,9 @@ class MeshTickEngine:
             return
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
+            # New logical tick so the "touched this tick" reclaim guard
+            # doesn't pin the previous tick's slots (see TickEngine).
+            self._tick_count += 1
             cols = []
             for u in updates:
                 shard = self._shard_of(u.key)
@@ -315,6 +322,70 @@ class MeshTickEngine:
                 m = np.zeros((8, pad_pow2(len(cols))), np.int64)
                 m[:, : len(cols)] = np.array(cols, np.int64).T
                 self.state = self._install(self.state, jnp.asarray(m), jnp.int64(now))
+
+    # ------------------------------------------------------------------
+    # Snapshot / restore (Loader.Load/Save analog; see TickEngine)
+    # ------------------------------------------------------------------
+    def export_items(self) -> List[dict]:
+        """Drain live bucket state to host dicts — one D2H gather of the
+        sharded table + one native key export per shard."""
+        with self._lock:
+            st = jax.tree.map(np.asarray, self.state)
+            mapped = np.concatenate([sm.mapped_mask() for sm in self.slots])
+            live = np.flatnonzero(mapped & st.in_use)
+            if len(live) == 0:
+                return []
+            keys: List[bytes] = []
+            owner = live // self.local_capacity
+            for d in range(self.n_shards):
+                sel = live[owner == d] - d * self.local_capacity
+                if len(sel):
+                    keys.extend(self.slots[d].keys_batch(sel))
+            return items_from_columns(keys, st, live)
+
+    def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
+        """Install snapshot items into the sharded table: route each key to
+        its shard, batch-assign per shard, one jitted scatter for the data
+        (XLA places each row on its owning device)."""
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            self._tick_count += 1  # unblock LRU reclaim (see install_globals)
+            live = [it for it in items if it["expire_at"] >= now]
+            if not live:
+                return
+            by_shard: List[List[int]] = [[] for _ in range(self.n_shards)]
+            for j, it in enumerate(live):
+                by_shard[self._shard_of(it["key"])].append(j)
+            gslots = np.full(len(live), -1, np.int64)
+            for d, idxs in enumerate(by_shard):
+                if not idxs:
+                    continue
+                lo = d * self.local_capacity
+                ls = self.slots[d].assign_batch(
+                    [live[j]["key"].encode() for j in idxs]
+                )
+                if (ls < 0).any():  # shard full: reclaim once, retry the rest
+                    # Stamp the rows just assigned live first — device state
+                    # is stale for them until the restore scatter runs, and
+                    # an unstamped reclaim would hand their slots to the
+                    # retried keys (same bug class as build_batch's retry).
+                    got = ls[ls >= 0]
+                    self._last_access[lo + got] = self._tick_count
+                    self._pending.update((lo + got).tolist())
+                    self._reclaim(d, now)
+                    retry = np.flatnonzero(ls < 0)
+                    ls[retry] = self.slots[d].assign_batch(
+                        [live[idxs[r]]["key"].encode() for r in retry]
+                    )
+                gslots[idxs] = np.where(ls >= 0, lo + ls, -1)
+            ok = np.flatnonzero(gslots >= 0)  # full shards: drop those rows
+            if len(ok) == 0:
+                return
+            ints, floats = pack_restore_matrix(live, ok, gslots)
+            self._last_access[gslots[ok]] = self._tick_count
+            self.state = self._restore(
+                self.state, jnp.asarray(ints), jnp.asarray(floats)
+            )
 
     def cache_size(self) -> int:
         return sum(len(sm) for sm in self.slots)

@@ -123,13 +123,19 @@ class GlobalManager:
         (global.go:144-187)."""
         t0 = time.perf_counter()
         by_owner: Dict[str, tuple] = {}
+        local: List[RateLimitRequest] = []
         for r in hits:
             try:
                 peer = self.instance.get_peer(r.hash_key())
             except Exception:
                 continue
             if peer is None or peer.info.is_owner:
-                continue  # we own it; nothing to forward
+                # Ownership moved to this node between queueing and flush
+                # (or we're standalone): the hits must still land — the
+                # reference forwards to whatever GetPeer resolves
+                # (global.go:153-168), which here is our own peer handler.
+                local.append(r)
+                continue
             addr = peer.info.grpc_address
             if addr in by_owner:
                 by_owner[addr][1].append(r)
@@ -148,8 +154,18 @@ class GlobalManager:
                     except Exception:
                         pass  # peer records the error for HealthCheck
 
+        async def apply_self(reqs):
+            # Same handler an owner applies to relayed batches: forces
+            # DRAIN_OVER_LIMIT on GLOBAL hits and queues the broadcast.
+            for i in range(0, len(reqs), limit):
+                try:
+                    await self.instance.get_peer_rate_limits(reqs[i : i + limit])
+                except Exception:
+                    pass
+
         await asyncio.gather(
-            *(send(p, reqs) for p, reqs in by_owner.values())
+            *(send(p, reqs) for p, reqs in by_owner.values()),
+            *((apply_self(local),) if local else ()),
         )
         if self.metrics is not None:
             self.metrics.global_send_duration.observe(time.perf_counter() - t0)

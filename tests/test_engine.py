@@ -97,6 +97,25 @@ def test_eviction_lru_when_nothing_expired():
     assert s.engine.metric_unexpired_evictions > 0
 
 
+def test_reclaim_retry_does_not_corrupt_same_batch_slots():
+    # A mid-batch reclaim triggered by the table-full retry must not release
+    # slots resolved earlier in the SAME batch: fresh misses look unused on
+    # device until the tick lands, and an unstamped reclaim would hand their
+    # slots to the retried keys (two keys, one bucket).
+    s = Sim(capacity=2, max_batch=8)
+    s.batch([req(key="old", duration=10)])  # occupies 1 of 2 slots
+    s.advance(1000)                          # "old" expires
+    rs = s.batch([
+        req(key="A", duration=600_000),      # takes the last free slot
+        req(key="B", duration=600_000),      # table full → reclaim → retry
+    ])
+    assert [r.remaining for r in rs] == [9, 9]
+    sa, sb = s.engine.slots.get("t_A"), s.engine.slots.get("t_B")
+    assert sa is not None and sb is not None and sa != sb
+    rs = s.batch([req(key="A"), req(key="B")])
+    assert [r.remaining for r in rs] == [8, 8]
+
+
 def test_snapshot_roundtrip():
     # Loader.Save/Load analog (workers.go:329-534).
     s = Sim()

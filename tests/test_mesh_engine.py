@@ -76,6 +76,21 @@ def test_spill_chunking_beyond_tick_budget():
     assert all(r.error == "" and r.remaining == 99 for r in out)
 
 
+def test_mesh_snapshot_roundtrip():
+    """Loader.Save/Load over the sharded table (see TickEngine analog)."""
+    mesh = make_mesh(jax.devices())
+    e1 = MeshTickEngine(mesh=mesh, local_capacity=64, max_batch=64)
+    e1.process([req(f"snap{i}", hits=3, limit=9) for i in range(40)], now=NOW)
+    items = e1.export_items()
+    assert len(items) == 40
+    e2 = MeshTickEngine(mesh=mesh, local_capacity=64, max_batch=64)
+    e2.load_items(items, now=NOW)
+    out = e2.process(
+        [req(f"snap{i}", hits=0, limit=9) for i in range(40)], now=NOW
+    )
+    assert all(r.remaining == 6 for r in out), out
+
+
 def test_matches_single_device_engine():
     """The sharded tick must agree with the single-chip engine bit-for-bit."""
     from gubernator_tpu.ops.engine import TickEngine

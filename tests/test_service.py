@@ -190,6 +190,35 @@ async def test_global_behavior_reconciles():
         await c.stop()
 
 
+async def test_global_hits_apply_locally_when_owner():
+    """Hits queued for a key this node turns out to own must still land
+    (the reference forwards to whatever GetPeer resolves, global.go:153-168;
+    dropping them loses accounting for good)."""
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+
+    behaviors = BehaviorConfig(global_sync_wait=0.02, batch_wait=0.001)
+    inst = await V1Instance.create(
+        InstanceConfig(behaviors=behaviors, cache_size=256)
+    )
+    try:
+        r = req(name="gl", key="own", hits=3, limit=10,
+                behavior=Behavior.GLOBAL)
+        inst.global_mgr.queue_hit(r)
+
+        async def settled():
+            while True:
+                out = await inst.apply_local(
+                    [req(name="gl", key="own", hits=0, limit=10)]
+                )
+                if out[0].remaining == 7:
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(settled(), timeout=5)
+    finally:
+        await inst.close()
+
+
 async def test_http_gateway_snake_case():
     """JSON gateway with snake_case fields (daemon.go:245-261 parity)."""
     import aiohttp

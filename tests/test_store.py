@@ -94,6 +94,36 @@ def test_file_loader(tmp_path):
     assert out.remaining == 1
 
 
+async def test_loader_with_mesh_engine():
+    """Loader restore/save must work on the sharded engine too (it crashed
+    with AttributeError before MeshTickEngine grew load/export_items)."""
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+
+    loader = MockLoader()
+    inst = await V1Instance.create(
+        InstanceConfig(cache_size=512, tpu_mesh_shards=2, loader=loader)
+    )
+    try:
+        out = await inst.get_rate_limits([req(key="mesh-loader", hits=2)])
+        assert out[0].remaining == 3
+    finally:
+        await inst.close()
+    assert loader.called["Save()"] == 1
+    assert len(loader.contents) == 1
+    assert loader.contents[0]["remaining"] == 3
+
+
+def test_store_with_mesh_shards_rejected():
+    """Store write/read-through has no sharded path yet: combining it with
+    tpu_mesh_shards > 1 must fail loudly, not silently drop persistence."""
+    from gubernator_tpu.service.instance import InstanceConfig, _make_engine
+    from gubernator_tpu.store import MockStore
+
+    conf = InstanceConfig(store=MockStore(), tpu_mesh_shards=2, cache_size=256)
+    with pytest.raises(ValueError, match="Store"):
+        _make_engine(conf)
+
+
 def test_loader_drops_expired_items():
     eng = TickEngine(capacity=256, max_batch=64)
     eng.process([req(hits=1, duration=1000)], now=NOW)
