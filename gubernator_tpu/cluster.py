@@ -56,17 +56,36 @@ class Cluster:
         behaviors: Optional[BehaviorConfig] = None,
         cache_size: int = 4096,
         http_gateway: bool = False,
+        global_mesh: bool = False,
     ) -> "Cluster":
         """Boot ``n`` daemons (dc layout via ``datacenters``, one entry per
-        daemon) and wire them into one cluster (cluster.go:123-189)."""
+        daemon) and wire them into one cluster (cluster.go:123-189).
+
+        ``global_mesh=True`` models mesh-resident peers: all daemons share
+        one MeshGlobalEngine (one device per daemon) so GLOBAL limits
+        reconcile via collectives instead of the gRPC loops.
+        """
         c = cls()
         datacenters = list(datacenters or [""] * n)
         assert len(datacenters) == n
-        for dc in datacenters:
+        mesh_engine = None
+        if global_mesh:
+            from gubernator_tpu.parallel.global_mesh import (
+                MeshGlobalEngine,
+                make_global_mesh,
+            )
+
+            sync_ms = int((behaviors or BehaviorConfig()).global_sync_wait * 500)
+            mesh_engine = MeshGlobalEngine(
+                mesh=make_global_mesh(n),
+                capacity=min(cache_size, 1 << 16),
+                min_reconcile_ms=sync_ms,
+            )
+        for idx, dc in enumerate(datacenters):
             conf = _daemon_config(dc, behaviors, cache_size)
             if http_gateway:
                 conf.http_listen_address = "127.0.0.1:0"
-            d = Daemon(conf)
+            d = Daemon(conf, global_mesh=mesh_engine, global_mesh_node=idx)
             await d.start()
             c.daemons.append(d)
         c.peers = [

@@ -75,6 +75,12 @@ class Config:
     tpu_max_batch: int = 4096        # request columns per device tick
     tpu_mesh_shards: int = 0         # 0 = single-chip TickEngine; N = mesh
     tpu_platform: str = ""           # force jax platform ("cpu" for tests)
+    # GLOBAL reconciliation over the device mesh (collectives data plane,
+    # parallel/global_mesh.py): N logical peer-nodes; 0 = gRPC loops only.
+    # Node index -1 = auto (jax.process_index(), the multi-host identity).
+    tpu_global_mesh_nodes: int = 0
+    tpu_global_mesh_node: int = -1
+    tpu_global_mesh_capacity: int = 1 << 16
 
     # Optional persistence hooks (reference store.go).
     loader: Optional[object] = None
@@ -269,6 +275,11 @@ def setup_daemon_config(
         tpu_max_batch=r.int_("GUBER_TPU_MAX_BATCH", 4096),
         tpu_mesh_shards=r.int_("GUBER_TPU_MESH_SHARDS", 0),
         tpu_platform=r.str_("GUBER_TPU_PLATFORM"),
+        tpu_global_mesh_nodes=r.int_("GUBER_TPU_GLOBAL_MESH_NODES", 0),
+        tpu_global_mesh_node=r.int_("GUBER_TPU_GLOBAL_MESH_NODE", -1),
+        tpu_global_mesh_capacity=r.int_(
+            "GUBER_TPU_GLOBAL_MESH_CAPACITY", 1 << 16
+        ),
     )
     conf.set_defaults()
 

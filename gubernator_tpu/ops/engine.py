@@ -507,6 +507,40 @@ class SlotMap:
         return out
 
 
+def select_reclaim_victims(
+    mapped: np.ndarray,
+    in_use: np.ndarray,
+    expire: np.ndarray,
+    last_access: np.ndarray,
+    tick_count: int,
+    now: int,
+    want: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """TTL-then-LRU victim selection over a table (or a shard slice of one).
+
+    The one reclaim policy shared by all engines (expired-on-read eviction +
+    evict-oldest of lrucache.go:88-149): returns ``(expired, lru_victims)``
+    as local slot indices.  Expired slots release host-side with no device
+    work; LRU victims must *also* be device-evicted (their ``in_use`` is
+    still set, and stale state must not resurrect if the slot is reused).
+
+    ``mapped`` must already exclude host-pending slots (assigned but not
+    yet written by a tick); slots touched this tick are excluded here —
+    both look dead on device but are live.
+    """
+    mapped = mapped & (last_access != tick_count)
+    dead = mapped & (~in_use | (expire < now))
+    freed = np.flatnonzero(dead)
+    none = np.empty(0, np.int64)
+    if len(freed) >= want:
+        return freed, none
+    live = np.flatnonzero(mapped & ~dead)
+    n = min(want - len(freed), len(live))
+    if n <= 0:
+        return freed, none
+    return freed, live[np.argsort(last_access[live])[:n]]
+
+
 def make_slot_map(capacity: int):
     """Native C++ slotmap when the shared library is available (built by
     gubernator_tpu/native/Makefile), pure-Python fallback otherwise."""
@@ -595,30 +629,22 @@ class TickEngine:
 
     def _reclaim(self, now: int, want: Optional[int] = None) -> None:
         """Free expired slots; fall back to LRU eviction (lrucache.go:115-149)."""
-        want = want or max(1, self.capacity // 16)
-        in_use = np.asarray(self.state.in_use)
-        expire = np.asarray(self.state.expire_at)
         mapped = self.slots.mapped_mask()
-        # Slots assigned since the last tick look un-used on device; they are
-        # live, not dead.
         if self._pending:
-            pend = np.fromiter(self._pending, np.int64)
-            mapped[pend] = False
-        # Slots already touched this tick (refreshed known keys) may look
-        # expired on device until the tick lands — they are live too.
-        mapped &= self._last_access != self._tick_count
-        dead = mapped & (~in_use | (expire < now))
-        freed = np.flatnonzero(dead)
+            mapped[np.fromiter(self._pending, np.int64)] = False
+        freed, victims = select_reclaim_victims(
+            mapped,
+            np.asarray(self.state.in_use),
+            np.asarray(self.state.expire_at),
+            self._last_access,
+            self._tick_count,
+            now,
+            want or max(1, self.capacity // 16),
+        )
         self.slots.release_batch(freed)
-        if len(freed) >= want:
+        if len(victims) == 0:
             return
-        # LRU: evict the least-recently-touched live slots.
-        live = np.flatnonzero(mapped & ~dead)
-        if len(live) == 0:
-            return
-        n = min(want - len(freed), len(live))
-        victims = live[np.argsort(self._last_access[live])[:n]]
-        self.metric_unexpired_evictions += int(n)
+        self.metric_unexpired_evictions += len(victims)
         self.slots.release_batch(victims)
         padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
         padded[: len(victims)] = victims

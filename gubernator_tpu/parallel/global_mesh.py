@@ -1,0 +1,615 @@
+"""GLOBAL reconciliation as mesh collectives: the TPU-native data plane.
+
+The reference reconciles GLOBAL rate limits with two O(peers) RPC fans
+(``global.go``): **sendHits** — every non-owner aggregates observed hits per
+key and unicasts them to each key's owner (``global.go:144-187``) — and
+**broadcastPeers** — every owner pushes authoritative state to every other
+peer (``global.go:234-283``).  When the "peers" are shards of one TPU mesh
+(chips of a host, or hosts of a multi-host ICI/DCN mesh), both fans collapse
+into collectives on the device:
+
+* Each node keeps a **full replica** of the GLOBAL bucket table (the analog
+  of the reference's non-owner local cache answering GLOBAL requests,
+  ``gubernator.go:395-421``) plus a per-node **hit accumulator** (the analog
+  of ``globalManager.hits``, ``global.go:99-112``).
+* Slot ownership is by contiguous range: node ``d`` owns slots
+  ``[d*capacity/n, (d+1)*capacity/n)`` — the mesh analog of consistent-hash
+  key ownership.
+* One **reconcile step** (the 100ms ``GlobalSyncWait`` cadence) runs as a
+  single SPMD program:
+
+  1. ``all_gather`` the hit accumulators over the mesh and fold each node's
+     window into the authority in node order (or ``psum`` them into one
+     application when strict sequencing is waived).  This *is* sendHits: a
+     keyed reduction instead of O(peers) unicasts.
+  2. ``all_gather`` the per-node authoritative slices into a fresh
+     replicated base table.  This *is* broadcastPeers: one replication step
+     instead of O(peers^2) pushes.
+  3. Apply the summed hits to the base via the same branch-free
+     ``bucket_transition`` every request takes, with DRAIN_OVER_LIMIT forced
+     (the reference forces it on forwarded GLOBAL hits,
+     ``gubernator.go:510-512``) and RESET_REMAINING OR-folded across nodes
+     (``global.go:105-110``).  Every node computes the identical result, so
+     replicas re-synchronize with zero additional traffic.
+
+Between reconciles each node answers GLOBAL requests from its own replica
+(and applies them locally — the reference's non-owner drains its local
+cache copy too, ``getLocalRateLimit`` with IsOwner=false), while hits on
+slots the node doesn't own are scatter-added into its accumulator.  Hits on
+*owned* slots mutate the authoritative slice directly, matching the
+reference's owner path (``gubernator.go:604-606`` applies then broadcasts).
+
+Request parameters for the aggregated application (limit/duration/behavior/
+created_at of the *latest* request per slot, matching the reference keeping
+the queued request proto and summing hits into it) ride a per-node aux
+table; the winner across nodes is picked with a ``pmax`` over write stamps.
+
+gRPC remains the reconciliation transport only *across* meshes (separate
+clusters / DCs) — within a mesh no RPC is issued at all.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.ops.buckets import BucketState, ReqBatch, bucket_transition
+from gubernator_tpu.ops.engine import (
+    REQ_ROWS,
+    REQ_ROW_INDEX,
+    _rank_within_slot,
+    make_slot_map,
+    pack_request_col,
+    pack_resp,
+    pad_pow2,
+    resolve_gregorian,
+    unpack_reqs,
+)
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitRequest,
+    RateLimitResponse,
+)
+from gubernator_tpu.utils import timeutil
+
+I64 = jnp.int64
+I32 = jnp.int32
+
+# Aux rows: per-slot, per-node snapshot of the latest request's parameters —
+# the mesh analog of the queued RateLimitReq the reference ships to owners
+# (global.go:99-112 keeps the first request and sums hits into it; we keep
+# the latest, which matches the reference's queue_update replacement
+# semantics and lets limit changes propagate).
+AUX_ROWS = (
+    "limit", "duration", "algorithm", "behavior", "burst",
+    "greg_exp", "greg_dur", "created_at", "stamp",
+)
+AUX = {name: i for i, name in enumerate(AUX_ROWS)}
+
+# Accumulator rows (global.go:99-112's per-key aggregation, as dense arrays).
+ACC_HITS, ACC_RESET, ACC_COUNT = 0, 1, 2
+
+
+def make_global_mesh(n_nodes: Optional[int] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over the 'node' axis (one device = one logical peer)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_nodes is not None:
+            if len(devices) < n_nodes:
+                raise ValueError(
+                    f"global mesh needs {n_nodes} devices, "
+                    f"have {len(devices)}"
+                )
+            devices = devices[:n_nodes]
+    return Mesh(np.array(list(devices)), ("node",))
+
+
+def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
+    """Per-node GLOBAL request application + hit accumulation.
+
+    ``state``/``aux``/``accum`` carry one replica row per node (sharded over
+    'node'); ``reqs`` is ``(n_nodes, len(REQ_ROWS), B)`` — block *d* holds
+    the requests that arrived at node *d* this window.
+    """
+    slice_sz = capacity // n_nodes
+
+    def _local(state_blk, aux_blk, accum_blk, reqs_blk, now, stamp):
+        st = jax.tree.map(lambda a: a[0], state_blk)
+        aux = aux_blk[0]
+        acc = accum_blk[0]
+        r = unpack_reqs(reqs_blk[0])
+        my = lax.axis_index("node")
+
+        rank = _rank_within_slot(r.slot, r.valid, capacity)
+        n_rounds = jnp.max(jnp.where(r.valid, rank, 0)) + 1
+        b = r.slot.shape[0]
+        resp0 = (
+            jnp.zeros(b, I32), jnp.zeros(b, I64), jnp.zeros(b, I64),
+            jnp.zeros(b, I64), jnp.zeros(b, jnp.bool_),
+        )
+        aux_vals = jnp.stack([
+            r.limit, r.duration, r.algorithm.astype(I64),
+            r.behavior.astype(I64), r.burst, r.greg_exp, r.greg_dur,
+            r.created_at, jnp.full_like(r.limit, stamp),
+        ])
+
+        def cond(carry):
+            k, _, _, _ = carry
+            return k < n_rounds
+
+        def body(carry):
+            k, st, aux, resp = carry
+            active = r.valid & (rank == k)
+            gathered = jax.tree.map(lambda a: a[r.slot], st)
+            new_g, r_out = bucket_transition(now, gathered, r)
+            scat = jnp.where(active, r.slot, capacity)
+            st = jax.tree.map(
+                lambda tbl, upd: tbl.at[scat].set(upd, mode="drop"), st, new_g
+            )
+            aux = aux.at[:, scat].set(aux_vals, mode="drop")
+            new_resp = (r_out.status, r_out.limit, r_out.remaining,
+                        r_out.reset_time, r_out.over_limit)
+            resp = tuple(
+                jnp.where(active, n, o) for n, o in zip(new_resp, resp)
+            )
+            return k + 1, st, aux, resp
+
+        _, st, aux, resp = lax.while_loop(
+            cond, body, (jnp.int32(0), st, aux, resp0)
+        )
+
+        # Hit accumulation for non-owned slots (global.go:99-112): sum hits,
+        # OR RESET_REMAINING, count contributions.  Zero-hit queries are not
+        # queued (global.go:74-78).  Order-independent → one scatter-add.
+        owned = (r.slot // slice_sz) == my.astype(I32)
+        queue = r.valid & ~owned & (r.hits != 0)
+        qslot = jnp.where(queue, r.slot, capacity)
+        reset = queue & ((r.behavior & Behavior.RESET_REMAINING) != 0)
+        acc = jnp.stack([
+            acc[ACC_HITS].at[qslot].add(jnp.where(queue, r.hits, 0), mode="drop"),
+            acc[ACC_RESET].at[qslot].add(reset.astype(I64), mode="drop"),
+            acc[ACC_COUNT].at[qslot].add(queue.astype(I64), mode="drop"),
+        ])
+
+        packed = jnp.stack([
+            resp[0].astype(I64), resp[1], resp[2], resp[3],
+            resp[4].astype(I64),
+        ])
+        return (
+            jax.tree.map(lambda a: a[None], st),
+            aux[None],
+            acc[None],
+            packed[None],
+        )
+
+    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    return jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(state_spec, P("node", None, None), P("node", None, None),
+                  P("node", None, None), P(), P()),
+        out_specs=(state_spec, P("node", None, None), P("node", None, None),
+                   P("node", None, None)),
+        check_vma=False,
+    )
+
+
+def make_global_reconcile_fn(
+    mesh: Mesh, capacity: int, n_nodes: int, strict_sequencing: bool = True
+):
+    """The collective reconcile step: aggregate hits + replicate authority.
+
+    Collapses the reference's sendHits (global.go:144-187) and
+    broadcastPeers (global.go:234-283) RPC fans into collectives.  With
+    ``strict_sequencing`` (default) each node's aggregated window applies
+    to the authority as its own batch, in node order — bit-exact with the
+    reference, where every peer's window arrives as a separate
+    GetPeerRateLimits RPC and is applied sequentially (edge branches like
+    the new-item over-ask, algorithms.go:240-248, are sequencing-
+    sensitive).  The non-strict path folds all nodes into one psum and a
+    single application — one dense pass instead of ``n_nodes``, for
+    deployments that accept aggregate-application semantics.
+    """
+    slice_sz = capacity // n_nodes
+
+    def _recon(state_blk, aux_blk, accum_blk, now):
+        # Every cross-node exchange below is a ``psum``: sum all-reduce is
+        # the one collective guaranteed to lower on every TPU toolchain in
+        # play (the tunneled AOT compiler rejects max all-reduce), and it
+        # rides ICI natively.  all_gather is expressed as a psum of
+        # one-hot-row buffers; broadcast as an ownership-masked psum.
+        my = lax.axis_index("node")
+        rep = jax.tree.map(lambda a: a[0], state_blk)
+
+        # broadcastPeers as a collective: every node contributes its owned
+        # (authoritative) slice, masked psum reassembles the full table in
+        # slot order on every node — replicas are now the authoritative
+        # state, exactly what UpdatePeerGlobals installs
+        # (gubernator.go:425-459).
+        owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
+
+        def bcast(a):
+            if a.dtype == jnp.bool_:
+                return lax.psum(
+                    jnp.where(owned, a, False).astype(I32), "node"
+                ) > 0
+            return lax.psum(jnp.where(owned, a, jnp.zeros((), a.dtype)), "node")
+
+        base = jax.tree.map(bcast, rep)
+
+        def gather_rows(x):
+            """all_gather x over 'node' via one-hot psum → (n_nodes, *x.shape)."""
+            buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
+            return lax.psum(buf, "node")
+
+        # Latest request parameters across nodes: max over write stamps
+        # (ties broken by node index), then a masked psum selects the
+        # winner's aux row — the aggregated request proto of global.go:99-112.
+        aux = aux_blk[0]
+        stamp = aux[AUX["stamp"]]
+        key = jnp.where(
+            stamp > 0, stamp * n_nodes + my.astype(I64), jnp.int64(-1)
+        )
+        win = jnp.max(gather_rows(key), axis=0)
+        mine = (key == win) & (win >= 0)
+        params = lax.psum(jnp.where(mine[None, :], aux, 0), "node")
+        havep = win >= 0
+
+        # Forwarded GLOBAL hits get DRAIN_OVER_LIMIT forced
+        # (gubernator.go:510-512); RESET_REMAINING applies iff queued this
+        # window (stale RESET bits in aux must not re-fire).
+        base_behavior = jnp.where(havep, params[AUX["behavior"]], 0).astype(I32)
+        base_behavior = base_behavior & ~jnp.int32(Behavior.RESET_REMAINING)
+        base_behavior = base_behavior | jnp.int32(Behavior.DRAIN_OVER_LIMIT)
+
+        def make_req(hits, reset, valid):
+            return ReqBatch(
+                slot=jnp.arange(capacity, dtype=I32),
+                known=jnp.ones(capacity, jnp.bool_),
+                hits=hits,
+                limit=jnp.where(havep, params[AUX["limit"]], base.limit),
+                duration=jnp.where(
+                    havep, params[AUX["duration"]], base.duration
+                ),
+                algorithm=jnp.where(
+                    havep, params[AUX["algorithm"]], base.algorithm.astype(I64)
+                ).astype(I32),
+                behavior=jnp.where(
+                    reset > 0,
+                    base_behavior | jnp.int32(Behavior.RESET_REMAINING),
+                    base_behavior,
+                ),
+                created_at=jnp.where(havep, params[AUX["created_at"]], now),
+                burst=jnp.where(havep, params[AUX["burst"]], base.burst),
+                greg_exp=params[AUX["greg_exp"]],
+                greg_dur=params[AUX["greg_dur"]],
+                valid=valid,
+            )
+
+        def apply(st, hits, reset, valid):
+            # Dense application: slot i ↔ request i — no gather/scatter, no
+            # rank rounds; the whole table updates in one elementwise pass.
+            new_state, _ = bucket_transition(
+                now, st, make_req(hits, reset, valid)
+            )
+            return jax.tree.map(
+                lambda n, b: jnp.where(valid, n, b), new_state, st
+            )
+
+        if strict_sequencing:
+            # sendHits, exactly: every node's window is one batch at the
+            # authority, applied in node order (all_gather + on-device fold).
+            acc_all = gather_rows(accum_blk[0])  # (n, 3, capacity)
+
+            def fold(d, st):
+                return apply(
+                    st,
+                    acc_all[d, ACC_HITS],
+                    acc_all[d, ACC_RESET],
+                    acc_all[d, ACC_COUNT] > 0,
+                )
+
+            merged = lax.fori_loop(0, n_nodes, fold, base)
+        else:
+            # sendHits as one reduction: cluster-total hits per slot.
+            acc = lax.psum(accum_blk[0], "node")
+            merged = apply(
+                base, acc[ACC_HITS], acc[ACC_RESET], acc[ACC_COUNT] > 0
+            )
+        return (
+            jax.tree.map(lambda a: a[None], merged),
+            jnp.zeros_like(accum_blk),
+        )
+
+    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    return jax.shard_map(
+        _recon,
+        mesh=mesh,
+        in_specs=(state_spec, P("node", None, None), P("node", None, None), P()),
+        out_specs=(state_spec, P("node", None, None)),
+        check_vma=False,
+    )
+
+
+def make_global_evict_fn(mesh: Mesh):
+    """Drop slots on every replica + clear their accumulators/stamps."""
+    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+
+    def _evict(state_blk, aux_blk, accum_blk, slots):
+        st = jax.tree.map(lambda a: a[0], state_blk)
+        st = st._replace(in_use=st.in_use.at[slots].set(False, mode="drop"))
+        aux = aux_blk[0].at[AUX["stamp"], slots].set(0, mode="drop")
+        acc = accum_blk[0].at[:, slots].set(0, mode="drop")
+        return (
+            jax.tree.map(lambda a: a[None], st), aux[None], acc[None],
+        )
+
+    return jax.shard_map(
+        _evict,
+        mesh=mesh,
+        in_specs=(state_spec, P("node", None, None), P("node", None, None), P()),
+        out_specs=(state_spec, P("node", None, None), P("node", None, None)),
+        check_vma=False,
+    )
+
+
+class MeshGlobalEngine:
+    """Host driver for the replicated GLOBAL table over a device mesh.
+
+    One instance is shared by every service node resident on the mesh (the
+    in-process cluster, or the per-host processes of a multi-host mesh);
+    each node calls :meth:`process` with its node index, and one driver
+    (any of them — calls are internally rate-limited) calls
+    :meth:`maybe_reconcile` on the GlobalSyncWait cadence.
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        capacity: int = 1 << 16,
+        max_batch: int = 1024,
+        min_reconcile_ms: int = 0,
+        strict_sequencing: bool = True,
+    ):
+        self.mesh = mesh if mesh is not None else make_global_mesh()
+        self.n_nodes = self.mesh.devices.size
+        # Capacity must split evenly into per-node authority slices.
+        self.capacity = -(-int(capacity) // self.n_nodes) * self.n_nodes
+        self.max_batch = int(max_batch)
+        self.min_reconcile_ms = int(min_reconcile_ms)
+
+        row = NamedSharding(self.mesh, P("node", None))
+        mat = NamedSharding(self.mesh, P("node", None, None))
+        self.state: BucketState = jax.tree.map(
+            lambda a: jax.device_put(
+                jnp.broadcast_to(a, (self.n_nodes,) + a.shape), row
+            ),
+            BucketState.zeros(self.capacity),
+        )
+        self.aux = jax.device_put(
+            jnp.zeros((self.n_nodes, len(AUX_ROWS), self.capacity), I64), mat
+        )
+        self.accum = jax.device_put(
+            jnp.zeros((self.n_nodes, 3, self.capacity), I64), mat
+        )
+        self._proc = jax.jit(
+            make_global_process_fn(self.mesh, self.capacity, self.n_nodes),
+            donate_argnums=(0, 1, 2),
+        )
+        self._recon = jax.jit(
+            make_global_reconcile_fn(
+                self.mesh, self.capacity, self.n_nodes, strict_sequencing
+            ),
+            donate_argnums=(0, 2),
+        )
+        self._evict = jax.jit(
+            make_global_evict_fn(self.mesh), donate_argnums=(0, 1, 2)
+        )
+        self.slots = make_slot_map(self.capacity)
+        self._last_access = np.zeros(self.capacity, np.int64)
+        self._pending: set = set()
+        self._tick_count = 0
+        self._last_reconcile_ms = 0
+        self._lock = threading.RLock()
+        self.metric_reconciles = 0
+        self._req_sharding = mat
+        self._warmup()
+
+    def _warmup(self) -> None:
+        m = np.zeros((self.n_nodes, len(REQ_ROWS), self.max_batch), np.int64)
+        m[:, REQ_ROW_INDEX["slot"], :] = self.capacity
+        self.state, self.aux, self.accum, _ = self._proc(
+            self.state, self.aux, self.accum,
+            jax.device_put(m, self._req_sharding), jnp.int64(0), jnp.int64(0),
+        )
+        self.state, self.accum = self._recon(
+            self.state, self.aux, self.accum, jnp.int64(0)
+        )
+        jax.block_until_ready(self.state)
+
+    # ------------------------------------------------------------------
+    # Request path (per node)
+    # ------------------------------------------------------------------
+    def process(
+        self,
+        requests: Sequence[RateLimitRequest],
+        node_idx: int = 0,
+        now: Optional[int] = None,
+    ) -> List[RateLimitResponse]:
+        """Apply GLOBAL requests that arrived at node ``node_idx``."""
+        blocks: List[Sequence[RateLimitRequest]] = [
+            [] for _ in range(self.n_nodes)
+        ]
+        blocks[node_idx] = requests
+        return self.process_blocks(blocks, now)[node_idx]
+
+    def process_blocks(
+        self,
+        blocks: Sequence[Sequence[RateLimitRequest]],
+        now: Optional[int] = None,
+    ) -> List[List[RateLimitResponse]]:
+        """Apply one window of GLOBAL requests, grouped by receiving node.
+
+        Every node's block lands in the same SPMD tick (one program launch
+        for the whole mesh); responses mirror the block structure.
+        """
+        if len(blocks) != self.n_nodes:
+            raise ValueError(f"expected {self.n_nodes} blocks, got {len(blocks)}")
+        out: List[List[Optional[RateLimitResponse]]] = [
+            [None] * len(blk) for blk in blocks
+        ]
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            todo = [list(range(len(blk))) for blk in blocks]
+            while any(todo):
+                left = self._tick_once(blocks, todo, out, now)
+                if left == todo:
+                    for d, idxs in enumerate(left):
+                        for j in idxs:
+                            out[d][j] = RateLimitResponse(
+                                error="global table full; eviction failed"
+                            )
+                    break
+                todo = left
+        return out  # type: ignore[return-value]
+
+    def _tick_once(self, blocks, todo, out, now):
+        b = self.max_batch
+        m = np.zeros((self.n_nodes, len(REQ_ROWS), b), np.int64)
+        m[:, REQ_ROW_INDEX["slot"], :] = self.capacity
+        self._tick_count += 1
+        spill = [[] for _ in range(self.n_nodes)]
+        where = {}
+        for d, idxs in enumerate(todo):
+            col = 0
+            for j in idxs:
+                r = blocks[d][j]
+                try:
+                    greg_exp, greg_dur = resolve_gregorian(r, now)
+                except timeutil.GregorianError as e:
+                    out[d][j] = RateLimitResponse(error=str(e))
+                    continue
+                if col >= b:
+                    spill[d].append(j)
+                    continue
+                slot, known = self._resolve(r.hash_key(), now)
+                if slot is None:
+                    spill[d].append(j)
+                    continue
+                pack_request_col(
+                    m[d], col, r, slot=slot, known=known, now=now,
+                    greg_exp=greg_exp, greg_dur=greg_dur,
+                )
+                where[(d, col)] = j
+                col += 1
+        if where:
+            self.state, self.aux, self.accum, resp = self._proc(
+                self.state, self.aux, self.accum,
+                jax.device_put(m, self._req_sharding),
+                jnp.int64(now), jnp.int64(self._tick_count),
+            )
+            self._pending.clear()
+            rm = np.asarray(resp)  # (n_nodes, 5, B)
+            for (d, col), j in where.items():
+                status, limit, remaining, reset, _ = rm[d, :, col]
+                out[d][j] = RateLimitResponse(
+                    status=int(status), limit=int(limit),
+                    remaining=int(remaining), reset_time=int(reset),
+                )
+        return spill
+
+    def _resolve(self, key: str, now: int):
+        known = self.slots.get(key) is not None
+        slot = self.slots.assign(key)
+        if slot is None:
+            self._reclaim(now)
+            known = self.slots.get(key) is not None
+            slot = self.slots.assign(key)
+            if slot is None:
+                return None, False
+        if not known:
+            self._pending.add(slot)
+        self._last_access[slot] = self._tick_count
+        return slot, known
+
+    def _reclaim(self, now: int) -> None:
+        """TTL-then-LRU slot reclamation (the shared policy,
+        engine.select_reclaim_victims) over the replicated table.
+
+        Authority for expiry is the owner's slice; rather than gather each
+        slice, read node 0's replica — correct at reconcile boundaries and
+        conservatively stale (never early) between them.
+        """
+        from gubernator_tpu.ops.engine import select_reclaim_victims
+
+        mapped = self.slots.mapped_mask()
+        if self._pending:
+            mapped[np.fromiter(self._pending, np.int64)] = False
+        freed, victims = select_reclaim_victims(
+            mapped,
+            np.asarray(self.state.in_use[0]),
+            np.asarray(self.state.expire_at[0]),
+            self._last_access,
+            self._tick_count,
+            now,
+            max(1, self.capacity // 16),
+        )
+        self.slots.release_batch(freed)
+        if len(victims) == 0:
+            return
+        self.slots.release_batch(victims)
+        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
+        padded[: len(victims)] = victims
+        self.state, self.aux, self.accum = self._evict(
+            self.state, self.aux, self.accum, jnp.asarray(padded)
+        )
+
+    # ------------------------------------------------------------------
+    # The collective reconcile (GlobalSyncWait cadence)
+    # ------------------------------------------------------------------
+    def reconcile(self, now: Optional[int] = None) -> None:
+        """One psum + all_gather reconciliation step (see module doc)."""
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            self.state, self.accum = self._recon(
+                self.state, self.aux, self.accum, jnp.int64(now)
+            )
+            self._pending.clear()
+            self._last_reconcile_ms = now
+            self.metric_reconciles += 1
+
+    def maybe_reconcile(self, now: Optional[int] = None) -> bool:
+        """Reconcile unless one ran within ``min_reconcile_ms`` (lets every
+        resident node drive the cadence without duplicate work)."""
+        now = now if now is not None else timeutil.now_ms()
+        if now - self._last_reconcile_ms < self.min_reconcile_ms:
+            return False
+        self.reconcile(now)
+        return True
+
+    def cache_size(self) -> int:
+        return len(self.slots)
+
+    # Introspection used by tests/benchmarks: per-node view of one key.
+    def peek(self, key: str) -> Optional[List[dict]]:
+        slot = self.slots.get(key)
+        if slot is None:
+            return None
+        st = jax.tree.map(lambda a: np.asarray(a[:, slot]), self.state)
+        return [
+            {
+                "remaining": int(st.remaining[d]),
+                "remaining_f": float(st.remaining_f[d]),
+                "status": int(st.status[d]),
+                "in_use": bool(st.in_use[d]),
+                "limit": int(st.limit[d]),
+            }
+            for d in range(self.n_nodes)
+        ]

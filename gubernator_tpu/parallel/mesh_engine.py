@@ -172,39 +172,31 @@ class MeshTickEngine:
         return g, known
 
     def _reclaim(self, shard: int, now: int) -> None:
-        """Free expired slots in one shard; fall back to LRU eviction.
+        """Free expired slots in one shard; fall back to LRU eviction —
+        the shared TTL/LRU policy (engine.select_reclaim_victims) over this
+        shard's slice of the table."""
+        from gubernator_tpu.ops.engine import select_reclaim_victims
 
-        Slots assigned since the last device tick (``_pending``) look unused
-        / stale on device but are live — never release them, and device-evict
-        LRU victims so stale state can't resurrect (the TickEngine reclaim
-        rules, engine.py).
-        """
         sm = self.slots[shard]
         lo = shard * self.local_capacity
-        expire = np.asarray(self.state.expire_at[lo : lo + self.local_capacity])
-        in_use = np.asarray(self.state.in_use[lo : lo + self.local_capacity])
         mapped = sm.mapped_mask()
         if self._pending:
             pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
             if pend:
                 mapped[np.asarray(pend, np.int64)] = False
-        # Slots already touched this tick (refreshed known keys) may look
-        # expired on device until the tick lands — they are live too.
-        mapped &= (
-            self._last_access[lo : lo + self.local_capacity] != self._tick_count
+        freed, victims = select_reclaim_victims(
+            mapped,
+            np.asarray(self.state.in_use[lo : lo + self.local_capacity]),
+            np.asarray(self.state.expire_at[lo : lo + self.local_capacity]),
+            self._last_access[lo : lo + self.local_capacity],
+            self._tick_count,
+            now,
+            max(1, self.local_capacity // 16),
         )
-        dead = mapped & (~in_use | (expire < now))
-        for s in np.flatnonzero(dead):
-            sm.release(int(s))
-        if np.any(dead):
+        sm.release_batch(freed)
+        if len(victims) == 0:
             return
-        live = np.flatnonzero(mapped)
-        if len(live) == 0:
-            return
-        n = max(1, self.local_capacity // 16)
-        victims = live[np.argsort(self._last_access[lo + live])[:n]]
-        for s in victims:
-            sm.release(int(s))
+        sm.release_batch(victims)
         padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
         padded[: len(victims)] = lo + victims
         self.state = self._evict(self.state, jnp.asarray(padded))

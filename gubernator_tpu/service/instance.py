@@ -67,6 +67,14 @@ class InstanceConfig:
     tpu_max_batch: int = 4096
     tpu_mesh_shards: int = 0             # 0 = single-chip engine
     tpu_platform: str = ""               # force jax platform ("cpu" for tests)
+    # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
+    # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
+    # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
+    global_mesh: Optional[object] = None
+    global_mesh_node: int = 0
+    tpu_global_mesh_nodes: int = 0       # >0: build own engine at startup
+    tpu_global_mesh_node: int = -1       # -1 = auto (jax.process_index())
+    tpu_global_mesh_capacity: int = 1 << 16
     loader: Optional[object] = None
     store: Optional[object] = None
     metrics: Optional[Metrics] = None
@@ -84,6 +92,9 @@ class InstanceConfig:
             tpu_max_batch=conf.tpu_max_batch,
             tpu_mesh_shards=conf.tpu_mesh_shards,
             tpu_platform=conf.tpu_platform,
+            tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
+            tpu_global_mesh_node=conf.tpu_global_mesh_node,
+            tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
             loader=conf.loader,
             store=conf.store,
             **kw,
@@ -152,6 +163,37 @@ class V1Instance:
             hash_fn, conf.replicas
         )
         self.global_mgr = GlobalManager(self, conf.behaviors, self.metrics)
+        # GLOBAL collectives data plane: use the shared engine if provided,
+        # else build one when GUBER_TPU_GLOBAL_MESH_NODES asks for it.
+        self.global_mesh = conf.global_mesh
+        if self.global_mesh is None and conf.tpu_global_mesh_nodes > 0:
+            from gubernator_tpu.parallel.global_mesh import (
+                MeshGlobalEngine,
+                make_global_mesh,
+            )
+
+            self.global_mesh = MeshGlobalEngine(
+                mesh=make_global_mesh(conf.tpu_global_mesh_nodes),
+                capacity=conf.tpu_global_mesh_capacity,
+                max_batch=conf.tpu_max_batch,
+                min_reconcile_ms=int(conf.behaviors.global_sync_wait * 500),
+            )
+            if conf.global_mesh_node == 0 and conf.tpu_global_mesh_node != 0:
+                # Env-configured mode: this node's identity on the mesh is
+                # its jax process index (multi-host meshes have one service
+                # process per host); -1 means exactly that auto-default.
+                import jax
+
+                conf.global_mesh_node = (
+                    jax.process_index()
+                    if conf.tpu_global_mesh_node < 0
+                    else conf.tpu_global_mesh_node
+                )
+        self._mesh_task: Optional[asyncio.Task] = None
+        if self.global_mesh is not None:
+            self._mesh_task = asyncio.create_task(
+                self._mesh_reconcile_loop(), name="global-mesh-reconcile"
+            )
         self._closed = False
 
     @classmethod
@@ -187,6 +229,7 @@ class V1Instance:
         created_at = timeutil.now_ms()
         out: List[Optional[RateLimitResponse]] = [None] * len(requests)
         local_idx: List[int] = []
+        mesh_idx: List[int] = []       # GLOBAL over the collectives plane
         global_idx: List[tuple] = []   # (i, owner_addr)
         forward: List[tuple] = []      # (i, peer, req, key)
 
@@ -204,6 +247,15 @@ class V1Instance:
                 req.created_at = created_at
             if self.conf.behaviors.force_global:
                 req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, True)
+
+            if self.global_mesh is not None and has_behavior(
+                req.behavior, Behavior.GLOBAL
+            ):
+                # Mesh-resident GLOBAL: ownership is the slot range on the
+                # device mesh, not the consistent-hash ring; every node
+                # answers from its replica and reconciles via collectives.
+                mesh_idx.append(i)
+                continue
 
             peer = self.get_peer(key)
             if peer is None or peer.info.is_owner:
@@ -229,6 +281,17 @@ class V1Instance:
                 )
             )
 
+        # GLOBAL items on the mesh data plane: one device tick, no RPC.
+        mesh_done = None
+        if mesh_idx:
+            mesh_reqs = [requests[i] for i in mesh_idx]
+            mesh_done = asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.global_mesh.process(
+                    mesh_reqs, self.conf.global_mesh_node
+                ),
+            )
+
         # Forwarded items: per-item task with retry/ownership-reresolution.
         fwd_tasks = [
             asyncio.ensure_future(self._async_request(peer, req, key))
@@ -241,6 +304,12 @@ class V1Instance:
         if globals_done is not None:
             for (i, owner), resp in zip(global_idx, await globals_done):
                 resp.metadata = {"owner": owner}
+                out[i] = resp
+        if mesh_done is not None:
+            for i, resp in zip(mesh_idx, await mesh_done):
+                self.metrics.getratelimit_counter.labels(calltype="global").inc()
+                if resp.status == Status.OVER_LIMIT:
+                    self.metrics.over_limit_counter.inc()
                 out[i] = resp
         for (i, _, _, _), t in zip(forward, fwd_tasks):
             out[i] = await t
@@ -286,6 +355,21 @@ class V1Instance:
             self.global_mgr.queue_hit(r)
             self.metrics.getratelimit_counter.labels(calltype="global").inc()
         return resps
+
+    async def _mesh_reconcile_loop(self) -> None:
+        """Drive the collective reconcile at the GlobalSyncWait cadence
+        (global.go:193-283's loops, collapsed into one device step).  Every
+        mesh-resident instance runs this; the engine's min-interval gate
+        dedupes concurrent drivers."""
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            await asyncio.sleep(self.conf.behaviors.global_sync_wait)
+            try:
+                await loop.run_in_executor(
+                    None, self.global_mesh.maybe_reconcile
+                )
+            except Exception:
+                self.log.exception("global mesh reconcile failed")
 
     async def _async_request(
         self, peer: PeerClient, req: RateLimitRequest, key: str
@@ -461,6 +545,12 @@ class V1Instance:
             return
         self._closed = True
         await self.global_mgr.close()
+        if self._mesh_task is not None:
+            self._mesh_task.cancel()
+            try:
+                await self._mesh_task
+            except (asyncio.CancelledError, Exception):
+                pass
         for p in set(self.local_picker.peers()) | set(self.region_picker.peers()):
             try:
                 await p.shutdown()

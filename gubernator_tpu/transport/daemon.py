@@ -132,11 +132,14 @@ class PeersServicer:
 class Daemon:
     """One running node: instance + listeners + discovery."""
 
-    def __init__(self, conf: DaemonConfig, engine=None):
+    def __init__(self, conf: DaemonConfig, engine=None, global_mesh=None,
+                 global_mesh_node: int = 0):
         self.conf = conf
         self.metrics = Metrics()
         self.instance: Optional[V1Instance] = None
         self._engine = engine
+        self._global_mesh = global_mesh
+        self._global_mesh_node = global_mesh_node
         self._grpc_server: Optional[grpc.aio.Server] = None
         self._http_runner: Optional[web.AppRunner] = None
         self._status_runner: Optional[web.AppRunner] = None
@@ -184,6 +187,9 @@ class Daemon:
             ),
         )
         iconf.data_center = self.conf.data_center or self.conf.config.data_center
+        if self._global_mesh is not None:
+            iconf.global_mesh = self._global_mesh
+            iconf.global_mesh_node = self._global_mesh_node
         self.instance = await V1Instance.create(iconf, engine=self._engine)
         server.add_generic_rpc_handlers(
             (
