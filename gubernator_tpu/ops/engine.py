@@ -50,12 +50,16 @@ from gubernator_tpu.types import (
 from gubernator_tpu.utils import timeutil
 
 
-def _rank_within_slot(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
-    """Arrival rank of each request among requests sharing its slot.
+def _slot_segments(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
+    """Per-request segment info for requests sharing a slot.
 
     Stable-sorts by slot (invalid rows pushed past ``capacity``), computes a
-    segmented iota over equal-slot runs, and scatters ranks back to request
-    order.  O(B log B), no table-sized buffers.
+    segmented iota over equal-slot runs, and scatters everything back to
+    request order.  O(B log B), no table-sized buffers.  Returns
+    ``(rank, group_size, head_idx, seg_id)``: arrival rank within the slot
+    group, the group's member count, the original index of the group's
+    first request, and a dense segment id usable as a B-bounded scatter
+    target for segmented reductions.
     """
     b = slot.shape[0]
     sort_key = jnp.where(valid, slot, capacity).astype(jnp.int64)
@@ -67,8 +71,19 @@ def _rank_within_slot(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
     )
     seg_start = lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, 0))
     rank_sorted = idx - seg_start
-    rank = jnp.zeros(b, jnp.int32).at[order].set(rank_sorted)
-    return rank
+    seg_id_sorted = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    sizes = jnp.zeros(b, jnp.int32).at[seg_id_sorted].add(1)
+    inv = jnp.zeros(b, jnp.int32).at[order].set(idx)  # request → sorted pos
+    rank = rank_sorted[inv]
+    seg_id = seg_id_sorted[inv]
+    group_size = sizes[seg_id]
+    head_idx = order[seg_start][inv]
+    return rank, group_size, head_idx, seg_id
+
+
+def _rank_within_slot(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
+    """Arrival rank of each request among requests sharing its slot."""
+    return _slot_segments(slot, valid, capacity)[0]
 
 
 def pad_pow2(n: int) -> int:
@@ -162,17 +177,31 @@ def pack_resp(resp: RespBatch) -> jnp.ndarray:
     )
 
 
-def make_tick_fn(capacity: int):
+def make_tick_fn(capacity: int, merge_uniform: bool = True):
     """Build the jittable tick: (state, reqs, now) → (state, responses).
 
     Pure function of its inputs (no clocks, no host state) so the driver can
     compile-check it and shard it.
+
+    **Thundering-herd fast path** (``merge_uniform``): a batch full of
+    duplicates of one hot key is the reference's headline scenario
+    (docs/architecture.md, benchmark_test.go:122-147).  Naive rank rounds
+    cost one full gather+scatter per duplicate.  When every request in a
+    slot group is *identical* (same hits/limit/duration/algorithm/behavior/
+    created_at/burst, hits>0, token bucket, no RESET/Gregorian) the
+    sequential fold over the group has a closed form in the member's rank:
+    the group head runs the normal transition (handling new-item/renewal/
+    limit-delta), every follower's response is prefix arithmetic on the
+    head's post-state, and only the last member scatters the final state.
+    Duplicate cost collapses from O(dups) rounds to O(1); mixed groups fall
+    back to rank rounds bounded by the *non-merged* ranks only.
     """
 
     def tick(state: BucketState, reqs: ReqBatch, now: jnp.ndarray):
         b = reqs.slot.shape[0]
-        rank = _rank_within_slot(reqs.slot, reqs.valid, capacity)
-        n_rounds = jnp.max(jnp.where(reqs.valid, rank, 0)) + 1
+        rank, group_size, head_idx, seg_id = _slot_segments(
+            reqs.slot, reqs.valid, capacity
+        )
 
         resp0 = RespBatch(
             status=jnp.zeros(b, jnp.int32),
@@ -182,13 +211,7 @@ def make_tick_fn(capacity: int):
             over_limit=jnp.zeros(b, jnp.bool_),
         )
 
-        def cond(carry):
-            k, _, _ = carry
-            return k < n_rounds
-
-        def body(carry):
-            k, st, resp = carry
-            active = reqs.valid & (rank == k)
+        def round_step(k, st, resp, active):
             gathered = jax.tree.map(lambda a: a[reqs.slot], st)
             new_g, r_out = bucket_transition(now, gathered, reqs)
             # Scatter only this round's rows; inactive rows aim out of
@@ -200,9 +223,38 @@ def make_tick_fn(capacity: int):
             resp = jax.tree.map(
                 lambda old, new: jnp.where(active, new, old), resp, r_out
             )
+            return st, resp
+
+        # Round 0: every group head takes the full transition (new item,
+        # renewal, limit delta, RESET — all head-only concerns).
+        state, resp = round_step(
+            0, state, resp0, reqs.valid & (rank == 0)
+        )
+
+        if merge_uniform:
+            state, resp, merged = _apply_merged_followers(
+                state, resp, reqs, now, capacity,
+                rank, group_size, head_idx, seg_id,
+            )
+        else:
+            merged = jnp.zeros(b, jnp.bool_)
+
+        # Rank rounds for whatever didn't merge (mixed-parameter groups,
+        # leaky duplicates, RESET/Gregorian flows): round k applies at most
+        # one request per slot.
+        pending = reqs.valid & ~merged
+        n_rounds = jnp.max(jnp.where(pending, rank, 0)) + 1
+
+        def cond(carry):
+            k, _, _ = carry
+            return k < n_rounds
+
+        def body(carry):
+            k, st, resp = carry
+            st, resp = round_step(k, st, resp, pending & (rank == k))
             return k + 1, st, resp
 
-        _, state, resp = lax.while_loop(cond, body, (jnp.int32(0), state, resp0))
+        _, state, resp = lax.while_loop(cond, body, (jnp.int32(1), state, resp0 if False else resp))
         return state, resp
 
     def tick_packed(state: BucketState, packed: jnp.ndarray, now: jnp.ndarray):
