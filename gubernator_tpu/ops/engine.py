@@ -41,10 +41,12 @@ from gubernator_tpu.ops.buckets import (
     bucket_transition,
 )
 from gubernator_tpu.types import (
+    Algorithm,
     Behavior,
     GlobalUpdate,
     RateLimitRequest,
     RateLimitResponse,
+    Status,
     has_behavior,
 )
 from gubernator_tpu.utils import timeutil
@@ -177,6 +179,118 @@ def pack_resp(resp: RespBatch) -> jnp.ndarray:
     )
 
 
+def _apply_merged_followers(
+    state: BucketState,
+    resp: RespBatch,
+    reqs: ReqBatch,
+    now: jnp.ndarray,
+    capacity: int,
+    rank: jnp.ndarray,
+    group_size: jnp.ndarray,
+    head_idx: jnp.ndarray,
+    seg_id: jnp.ndarray,
+):
+    """Closed-form application of duplicate-key followers (token bucket).
+
+    Called after round 0 (all group heads applied).  For a slot group whose
+    members are *identical* token-bucket requests (hits>0, no
+    RESET_REMAINING/Gregorian), the sequential fold the rank rounds would
+    perform has a closed form in the member's rank ``i`` against the
+    post-head state ``(R0=remaining, S0=status, E=expire_at)``:
+
+        q = R0 // h                    # followers the bucket can still absorb
+        i <= q  → UNDER, remaining R0 - i·h, status echoes stored S0
+        i >  q  → OVER_LIMIT, remaining = drain ? 0 : R0 - q·h
+                  (divisible R0 makes R0 - q·h == 0, unifying the
+                  exact-remainder → at-zero and over-ask cases)
+
+    matching algorithms.go:157-198 exactly: the ``i <= q`` steps are the
+    dec/exact branches, ``i > q`` is over-ask until remaining hits zero and
+    the already-at-zero branch afterwards.  Stored status only flips to
+    OVER on an at-zero step (algorithms.go:162-169), which first occurs at
+    rank ``q+1`` when h divides R0, at ``q+2`` under DRAIN_OVER_LIMIT, and
+    never otherwise.  Only the *last* follower scatters state; expire/
+    created/duration are untouched (token hits never renew, and a uniform
+    group can't change limit or duration after its head).
+
+    Returns ``(state, resp, merged)`` where ``merged`` marks follower rows
+    handled here (they're excluded from the rank rounds).
+    """
+    b = reqs.slot.shape[0]
+    TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+    NO_MERGE = jnp.int32(
+        Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN
+    )
+
+    def hd(a):
+        return a[head_idx]
+
+    same_as_head = (
+        (reqs.hits == hd(reqs.hits))
+        & (reqs.limit == hd(reqs.limit))
+        & (reqs.duration == hd(reqs.duration))
+        & (reqs.behavior == hd(reqs.behavior))
+        & (reqs.created_at == hd(reqs.created_at))
+        & (reqs.burst == hd(reqs.burst))
+        & (reqs.algorithm == hd(reqs.algorithm))
+    )
+    # Followers must take the exists path (known & in_use & now<=expire);
+    # heads are exempt from the known check (their round-0 transition
+    # handles the new-item case and leaves in_use set).
+    ok = (
+        reqs.valid
+        & same_as_head
+        & (reqs.algorithm == TOKEN)
+        & (reqs.hits > 0)
+        & ((reqs.behavior & NO_MERGE) == 0)
+        & (reqs.known | (rank == 0))
+    )
+    # A group merges only if every valid member is mergeable: one bad row
+    # (different hits/limit/..., leaky, RESET) sends the whole group to the
+    # rank rounds so cross-member interactions stay sequential.
+    bad_per_seg = jnp.zeros(b, jnp.int32).at[seg_id].add(
+        (reqs.valid & ~ok).astype(jnp.int32)
+    )
+    group_ok = bad_per_seg[seg_id] == 0
+
+    # Post-head state of the group's slot.
+    slot = reqs.slot
+    R0 = state.remaining[slot]
+    S0 = state.status[slot]
+    E = state.expire_at[slot]
+    alive = now <= E
+
+    merged = group_ok & ok & alive & (rank > 0)
+
+    h = jnp.where(reqs.hits > 0, reqs.hits, jnp.int64(1))  # div-safe
+    i = rank.astype(jnp.int64)
+    q = R0 // h
+    drain = (reqs.behavior & Behavior.DRAIN_OVER_LIMIT) != 0
+    under = i <= q
+    rem_over = jnp.where(drain, jnp.int64(0), R0 - q * h)
+    rem_resp = jnp.where(under, R0 - i * h, rem_over)
+    resp = RespBatch(
+        status=jnp.where(merged, jnp.where(under, S0, OVER), resp.status),
+        limit=jnp.where(merged, reqs.limit, resp.limit),
+        remaining=jnp.where(merged, rem_resp, resp.remaining),
+        reset_time=jnp.where(merged, E, resp.reset_time),
+        over_limit=jnp.where(merged, ~under, resp.over_limit),
+    )
+
+    # Final state: scattered by the last follower alone.
+    is_last = merged & (rank == group_size - 1)
+    divisible = R0 - q * h == 0
+    at_zero_hit = jnp.where(divisible, i > q, drain & (i > q + 1))
+    status_final = jnp.where(at_zero_hit, OVER, S0)
+    scat = jnp.where(is_last, slot, capacity)
+    state = state._replace(
+        remaining=state.remaining.at[scat].set(rem_resp, mode="drop"),
+        status=state.status.at[scat].set(status_final, mode="drop"),
+    )
+    return state, resp, merged
+
+
 def make_tick_fn(capacity: int, merge_uniform: bool = True):
     """Build the jittable tick: (state, reqs, now) → (state, responses).
 
@@ -211,7 +325,7 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
             over_limit=jnp.zeros(b, jnp.bool_),
         )
 
-        def round_step(k, st, resp, active):
+        def round_step(st, resp, active):
             gathered = jax.tree.map(lambda a: a[reqs.slot], st)
             new_g, r_out = bucket_transition(now, gathered, reqs)
             # Scatter only this round's rows; inactive rows aim out of
@@ -227,9 +341,7 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
 
         # Round 0: every group head takes the full transition (new item,
         # renewal, limit delta, RESET — all head-only concerns).
-        state, resp = round_step(
-            0, state, resp0, reqs.valid & (rank == 0)
-        )
+        state, resp = round_step(state, resp0, reqs.valid & (rank == 0))
 
         if merge_uniform:
             state, resp, merged = _apply_merged_followers(
@@ -251,10 +363,10 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
 
         def body(carry):
             k, st, resp = carry
-            st, resp = round_step(k, st, resp, pending & (rank == k))
+            st, resp = round_step(st, resp, pending & (rank == k))
             return k + 1, st, resp
 
-        _, state, resp = lax.while_loop(cond, body, (jnp.int32(1), state, resp0 if False else resp))
+        _, state, resp = lax.while_loop(cond, body, (jnp.int32(1), state, resp))
         return state, resp
 
     def tick_packed(state: BucketState, packed: jnp.ndarray, now: jnp.ndarray):

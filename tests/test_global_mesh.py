@@ -232,7 +232,10 @@ async def test_cluster_global_mesh_service_path():
         out = await c0.get_rate_limits([g(5)])
         assert out[0].error == "" and out[0].remaining == 95
         out = await c1.get_rate_limits([g(7)])
-        assert out[0].error == "" and out[0].remaining == 93
+        # 93 if c1's replica hasn't absorbed c0's hits yet, 88 if the
+        # reconcile loop fired in between — both are correct non-owner
+        # local answers; convergence is asserted below.
+        assert out[0].error == "" and out[0].remaining in (93, 88)
 
         # The reconcile loops land the sum on every node's replica.
         async def synced():
