@@ -1,16 +1,39 @@
-"""Headline benchmark: rate-limit decisions/sec on one chip.
+"""Benchmark ladder: the BASELINE.md config ladder, end to end.
 
-Measures the steady-state throughput of the tick kernel — the fused
-gather → bucket-transition → scatter program that replaces the reference's
-per-key worker dispatch (``workers.go:190-324``, ``algorithms.go:37-493``).
+Rungs (BASELINE.json "configs", benchmark_test.go:30-148):
 
-Prints ONE JSON line.  ``vs_baseline`` is measured against the
-BASELINE.json target of 50M decisions/sec/chip (the reference itself
-publishes only ~2,000 req/s/node from production prose — see BASELINE.md —
-so the engineered target is the honest denominator).
+  kernel_1m            fused tick kernel, 1M slots, unique keys — the
+                       device ceiling (headline metric, vs the 50M
+                       decisions/s/chip engineered target)
+  engine_token_10k     TickEngine end-to-end: key hashing, native slotmap
+                       resolve, request packing, device tick, response
+                       unpack — token bucket, 10K keys
+  engine_leaky_1m      same, leaky bucket, 1M keys, uniform hits
+  engine_mixed_10m_zipf  same, mixed token+leaky, 10M keys, Zipf-skewed
+                       hits, table at capacity with reclaim live
+                       (p99 target: < 2ms per decision batch)
+  herd_token_4096 /    thundering herd: 4096 hits of ONE key per tick vs
+  herd_leaky_4096      the unique-key tick (benchmark_test.go:122-147)
+  snapshot_10m         export_items/load_items round-trip on the big
+                       table (Loader.Save/Load at scale; 1M under
+                       BENCH_FAST)
+  service_grpc         loopback daemon: full gRPC stack, 1000-item
+                       batches (the >2k req/s/node + <1ms reference
+                       prose, BASELINE.md)
+  global_mesh_8        GLOBAL reconciliation over an 8-device mesh
+                       (subprocess on the CPU backend with 8 virtual
+                       devices — the v5e-8 rung of the ladder, validated
+                       the same way the driver's dryrun_multichip is)
+
+Prints ONE JSON line: the headline metric plus a ``ladder`` field carrying
+every rung.  ``BENCH_FAST=1`` shrinks the big rungs for quick iteration.
 """
 
+import asyncio
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -20,27 +43,38 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 import numpy as np
 
-TARGET = 50_000_000.0
+TARGET_DECISIONS = 50_000_000.0  # BASELINE.json: >= 50M decisions/s/chip
+TARGET_P99_MS = 2.0              # BASELINE.json: p99 < 2ms at 10M hot keys
+FAST = bool(os.environ.get("BENCH_FAST"))
 
 
-def main():
+def _pcts(samples_ms):
+    a = np.sort(np.asarray(samples_ms))
+    return (
+        float(a[int(0.50 * (len(a) - 1))]),
+        float(a[int(0.99 * (len(a) - 1))]),
+    )
+
+
+# ----------------------------------------------------------------------
+# Rung 1: device kernel ceiling
+# ----------------------------------------------------------------------
+def rung_kernel():
     from gubernator_tpu.ops.buckets import BucketState
     from gubernator_tpu.ops.engine import REQ_ROWS, REQ_ROW_INDEX as rows, make_tick_fn
 
-    capacity = 1 << 20  # 1M slots resident in HBM
-    batch = 1 << 15     # 32768 decisions per tick
+    capacity = 1 << 20
+    batch = 1 << 15
     now = 1_700_000_000_000
 
     rng = np.random.default_rng(0)
     m = np.zeros((len(REQ_ROWS), batch), np.int64)
-    # Unique slots per tick (the common case; duplicate keys take extra
-    # rank-rounds and are exercised by the ladder configs instead).
     m[rows["slot"]] = rng.permutation(capacity)[:batch]
     m[rows["known"]] = 1
     m[rows["hits"]] = 1
     m[rows["limit"]] = 1_000_000
     m[rows["duration"]] = 3_600_000
-    m[rows["algorithm"]] = rng.integers(0, 2, batch)  # mixed token+leaky
+    m[rows["algorithm"]] = rng.integers(0, 2, batch)
     m[rows["created_at"]] = now
     m[rows["valid"]] = 1
 
@@ -48,7 +82,6 @@ def main():
     state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
     packed = jnp.asarray(m)
 
-    # Warm up / compile.
     state, resp = tick(state, packed, jnp.int64(now))
     jax.block_until_ready(resp)
 
@@ -58,19 +91,358 @@ def main():
         state, resp = tick(state, packed, jnp.int64(now + i))
     jax.block_until_ready(resp)
     dt = time.perf_counter() - t0
+    dps = batch * iters / dt
+    return {
+        "rung": "kernel_1m",
+        "decisions_per_sec": round(dps, 1),
+        "vs_target_50m": round(dps / TARGET_DECISIONS, 4),
+    }
 
-    decisions_per_sec = batch * iters / dt
+
+# ----------------------------------------------------------------------
+# Engine-level rungs: the full host path (keys → slotmap → pack → tick)
+# ----------------------------------------------------------------------
+def _reqs(ids, limit, duration, algo, hits=1):
+    """algo: 0 token, 1 leaky, None mixed — a key's algorithm is a function
+    of the key (real deployments pin one algorithm per limit name; drawing
+    it per-request would make one key flip algorithms within a batch)."""
+    from gubernator_tpu.types import RateLimitRequest
+
+    return [
+        RateLimitRequest(
+            name="bench",
+            unique_key=str(i),
+            hits=hits,
+            limit=limit,
+            duration=duration,
+            algorithm=(int(i) & 1) if algo is None else algo,
+        )
+        for i in ids
+    ]
+
+
+def _prefill(engine, n_keys, algo, now, chunk=4096):
+    """Insert n_keys distinct keys through the public process() path."""
+    t0 = time.perf_counter()
+    for start in range(0, n_keys, chunk):
+        ids = range(start, min(start + chunk, n_keys))
+        engine.process(_reqs(ids, 1_000_000, 3_600_000, algo), now=now)
+    return time.perf_counter() - t0
+
+
+def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=4096):
+    """algo: 0 token, 1 leaky, None mixed.  fresh_frac>0 keeps the table at
+    capacity so TTL/LRU reclaim runs during the measured window."""
+    from gubernator_tpu.ops.engine import TickEngine
+
+    now = 1_700_000_000_000
+    capacity = n_keys  # table exactly at the rung's key count
+    engine = TickEngine(capacity=capacity, max_batch=batch)
+    fill_s = _prefill(engine, n_keys, algo, now)
+
+    rng = np.random.default_rng(2)
+    batches = []
+    n_fresh = int(batch * fresh_frac)
+    fresh_next = n_keys
+    n_batches = min(ticks, 100)
+    for _ in range(n_batches):
+        if zipf:
+            ids = np.minimum(rng.zipf(1.2, batch) - 1, n_keys - 1)
+        else:
+            ids = rng.integers(0, n_keys, batch)
+        if n_fresh:
+            # Fresh keys against a full table force the reclaim path.
+            ids = ids.copy()
+            ids[:n_fresh] = np.arange(fresh_next, fresh_next + n_fresh)
+            fresh_next += n_fresh
+        batches.append(_reqs(ids, 1_000_000, 3_600_000, algo))
+
+    lat = []
+    done = 0
+    t0 = time.perf_counter()
+    for i in range(ticks):
+        b = batches[i % n_batches]
+        t1 = time.perf_counter()
+        engine.process(b, now=now + i)
+        lat.append((time.perf_counter() - t1) * 1e3)
+        done += len(b)
+    dt = time.perf_counter() - t0
+    p50, p99 = _pcts(lat)
+    out = {
+        "rung": label,
+        "keys": n_keys,
+        "fill_s": round(fill_s, 1),
+        "decisions_per_sec": round(done / dt, 1),
+        "batch": batch,
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "evictions": engine.metric_unexpired_evictions,
+    }
+    if fresh_frac:
+        out["p99_vs_2ms_target"] = round(p99 / TARGET_P99_MS, 4)
+    return out, engine
+
+
+def rung_herd(unique_dps, algo, label):
+    """One hot key hit 4096× per tick (benchmark_test.go:122-147's
+    thundering-herd scenario, scaled) — the merge fast path should hold it
+    near unique-key throughput for both algorithms."""
+    from gubernator_tpu.ops.engine import TickEngine
+
+    now = 1_700_000_000_000
+    batch = 4096
+    engine = TickEngine(capacity=1 << 14, max_batch=batch)
+    reqs = _reqs([0] * batch, 10**12, 3_600_000, algo)
+    engine.process(reqs, now=now)  # install the key
+    ticks = 50
+    t0 = time.perf_counter()
+    for i in range(ticks):
+        engine.process(reqs, now=now + i)
+    dt = time.perf_counter() - t0
+    dps = batch * ticks / dt
+    return {
+        "rung": label,
+        "decisions_per_sec": round(dps, 1),
+        "vs_unique_key_engine": round(dps / unique_dps, 4) if unique_dps else None,
+    }
+
+
+def rung_snapshot(engine, label):
+    """Loader.Save/Load round-trip on a populated table."""
+    from gubernator_tpu.ops.engine import TickEngine
+
+    t0 = time.perf_counter()
+    items = engine.export_items()
+    export_s = time.perf_counter() - t0
+    fresh = TickEngine(capacity=engine.capacity, max_batch=engine.max_batch)
+    t0 = time.perf_counter()
+    fresh.load_items(items, now=1_700_000_000_000)
+    load_s = time.perf_counter() - t0
+    return {
+        "rung": label,
+        "items": len(items),
+        "export_s": round(export_s, 2),
+        "load_s": round(load_s, 2),
+    }
+
+
+# ----------------------------------------------------------------------
+# Service-level rung: loopback gRPC through a real daemon
+# ----------------------------------------------------------------------
+async def _service_bench(n_batches, batch, concurrency):
+    from gubernator_tpu.config import BehaviorConfig, Config, DaemonConfig
+    from gubernator_tpu.transport.daemon import DaemonClient, spawn_daemon
+    from gubernator_tpu.types import RateLimitRequest
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="",
+        peer_discovery_type="none",
+    )
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=1 << 17)
+    d = await spawn_daemon(conf)
+    client = DaemonClient(d.advertise_address)
+    rng = np.random.default_rng(3)
+
+    def mk(i):
+        ids = rng.integers(0, 100_000, batch)
+        return [
+            RateLimitRequest(
+                name="svc",
+                unique_key=str(k),
+                hits=1,
+                limit=1_000_000,
+                duration=3_600_000,
+            )
+            for k in ids
+        ]
+
+    payloads = [mk(i) for i in range(min(n_batches, 32))]
+    await client.get_rate_limits(payloads[0])  # warm
+
+    lat = []
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one(i):
+        async with sem:
+            t0 = time.perf_counter()
+            await client.get_rate_limits(payloads[i % len(payloads)])
+            lat.append((time.perf_counter() - t0) * 1e3)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(n_batches)))
+    dt = time.perf_counter() - t0
+    await client.close()
+    await d.close()
+    p50, p99 = _pcts(lat)
+    return {
+        "rung": "service_grpc",
+        "batch": batch,
+        "requests_per_sec": round(n_batches * batch / dt, 1),
+        "batches_per_sec": round(n_batches / dt, 1),
+        "batch_p50_ms": round(p50, 3),
+        "batch_p99_ms": round(p99, 3),
+        "vs_ref_2k_reqs_per_node": round((n_batches * batch / dt) / 2000.0, 1),
+    }
+
+
+def rung_service():
+    n_batches = 50 if FAST else 200
+    return asyncio.run(_service_bench(n_batches, 1000, 8))
+
+
+# ----------------------------------------------------------------------
+# GLOBAL mesh rung (8 virtual devices, CPU backend, subprocess)
+# ----------------------------------------------------------------------
+def child_mesh():
+    """Runs in the subprocess: 8-device mesh, GLOBAL windows + reconcile."""
+    # The tunneled-TPU plugin's sitecustomize outranks JAX_PLATFORMS; force
+    # the CPU backend back the way tests/conftest.py does.
+    jax.config.update("jax_platforms", "cpu")
+    from gubernator_tpu.parallel.global_mesh import MeshGlobalEngine, make_global_mesh
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    n_nodes = 8
+    batch = 256
+    eng = MeshGlobalEngine(
+        mesh=make_global_mesh(n_nodes), capacity=1 << 13, max_batch=batch
+    )
+    rng = np.random.default_rng(4)
+    now = 1_700_000_000_000
+
+    def window(i):
+        return [
+            [
+                RateLimitRequest(
+                    name="g",
+                    unique_key=str(k),
+                    hits=1,
+                    limit=1_000_000,
+                    duration=3_600_000,
+                    behavior=Behavior.GLOBAL,
+                )
+                for k in rng.integers(0, 4096, batch)
+            ]
+            for _ in range(n_nodes)
+        ]
+
+    eng.process_blocks(window(0), now=now)  # warm/compile
+    eng.reconcile(now=now)
+
+    windows = [window(i) for i in range(8)]
+    iters = 10 if FAST else 25
+    t0 = time.perf_counter()
+    for i in range(iters):
+        eng.process_blocks(windows[i % len(windows)], now=now + i)
+        eng.reconcile(now=now + i)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "rung": "global_mesh_8",
+                "nodes": n_nodes,
+                "decisions_per_sec": round(iters * n_nodes * batch / dt, 1),
+                "reconciles_per_sec": round(iters / dt, 2),
+                "backend": "cpu-8dev",
+            }
+        )
+    )
+
+
+def rung_global_mesh():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    # Strip the tunneled-TPU plugin's sitecustomize path (see conftest.py).
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child-mesh"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        lines = out.stdout.strip().splitlines()
+        if not lines:
+            tail = out.stderr.strip().splitlines()[-8:]
+            return {"rung": "global_mesh_8", "error": " | ".join(tail)[:500]}
+        return json.loads(lines[-1])
+    except Exception as e:
+        return {"rung": "global_mesh_8", "error": str(e)[:200]}
+
+
+# ----------------------------------------------------------------------
+def probe_roundtrip():
+    """One synchronous dispatch+D2H on a trivial program: the latency floor
+    under every per-tick engine number (≈0.1ms on a local chip, tens of ms
+    when the device is reached over a tunnel)."""
+    f = jax.jit(lambda a: a + 1)
+    x = jnp.zeros(8)
+    np.asarray(f(x))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        np.asarray(f(x))
+    return round((time.perf_counter() - t0) / 10 * 1e3, 2)
+
+
+def main():
+    ladder = []
+    rt_ms = probe_roundtrip()
+    kern = rung_kernel()
+    ladder.append(kern)
+
+    r, _ = rung_engine("engine_token_10k", 10_000, 0, ticks=100 if FAST else 400)
+    ladder.append(r)
+    unique_dps = r["decisions_per_sec"]
+
+    n_leaky = 1 << 17 if FAST else 1 << 20
+    r, _ = rung_engine("engine_leaky_1m", n_leaky, 1, ticks=50 if FAST else 200)
+    ladder.append(r)
+
+    n_big = 1 << 20 if FAST else 10_000_000
+    r, big_engine = rung_engine(
+        "engine_mixed_10m_zipf",
+        n_big,
+        None,
+        ticks=30 if FAST else 100,
+        zipf=True,
+        fresh_frac=0.01,
+    )
+    ladder.append(r)
+    big_p99 = r["p99_ms"]
+
+    ladder.append(rung_herd(unique_dps, 0, "herd_token_4096"))
+    ladder.append(rung_herd(unique_dps, 1, "herd_leaky_4096"))
+    ladder.append(rung_snapshot(big_engine, "snapshot_10m"))
+    del big_engine
+
+    ladder.append(rung_service())
+    ladder.append(rung_global_mesh())
+
     print(
         json.dumps(
             {
                 "metric": "rate_limit_decisions_per_sec_per_chip",
-                "value": round(decisions_per_sec, 1),
+                "value": kern["decisions_per_sec"],
                 "unit": "decisions/s",
-                "vs_baseline": round(decisions_per_sec / TARGET, 4),
+                "vs_baseline": kern["vs_target_50m"],
+                "p99_ms_at_10m_keys": big_p99,
+                "p99_target_ms": TARGET_P99_MS,
+                "device_roundtrip_ms": rt_ms,
+                "ladder": ladder,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child-mesh" in sys.argv:
+        child_mesh()
+    else:
+        main()

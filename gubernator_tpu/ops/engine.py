@@ -190,34 +190,46 @@ def _apply_merged_followers(
     head_idx: jnp.ndarray,
     seg_id: jnp.ndarray,
 ):
-    """Closed-form application of duplicate-key followers (token bucket).
+    """Closed-form application of duplicate-key followers (token + leaky).
 
     Called after round 0 (all group heads applied).  For a slot group whose
-    members are *identical* token-bucket requests (hits>0, no
-    RESET_REMAINING/Gregorian), the sequential fold the rank rounds would
-    perform has a closed form in the member's rank ``i`` against the
-    post-head state ``(R0=remaining, S0=status, E=expire_at)``:
+    members are *identical* requests (hits>0, no RESET_REMAINING/Gregorian),
+    the sequential fold the rank rounds would perform has a closed form in
+    the member's rank ``i`` against the post-head state.  Let ``base`` be
+    the post-head integer remaining — ``remaining`` for token buckets,
+    ``trunc(remaining_f)`` for leaky (algorithms.go:383-387 works on the
+    truncated value) — and ``q = base // h``:
 
-        q = R0 // h                    # followers the bucket can still absorb
-        i <= q  → UNDER, remaining R0 - i·h, status echoes stored S0
-        i >  q  → OVER_LIMIT, remaining = drain ? 0 : R0 - q·h
-                  (divisible R0 makes R0 - q·h == 0, unifying the
+        i <= q  → UNDER, remaining base - i·h
+                  (token echoes stored status S0, leaky reports UNDER)
+        i >  q  → OVER_LIMIT, remaining = drain ? 0 : base - q·h
+                  (divisible base makes base - q·h == 0, unifying the
                   exact-remainder → at-zero and over-ask cases)
 
-    matching algorithms.go:157-198 exactly: the ``i <= q`` steps are the
-    dec/exact branches, ``i > q`` is over-ask until remaining hits zero and
-    the already-at-zero branch afterwards.  Stored status only flips to
-    OVER on an at-zero step (algorithms.go:162-169), which first occurs at
-    rank ``q+1`` when h divides R0, at ``q+2`` under DRAIN_OVER_LIMIT, and
-    never otherwise.  Only the *last* follower scatters state; expire/
-    created/duration are untouched (token hits never renew, and a uniform
-    group can't change limit or duration after its head).
+    matching algorithms.go:157-198 (token) and :389-430 (leaky) exactly:
+    the ``i <= q`` steps are the dec/exact branches, ``i > q`` is over-ask
+    until remaining hits zero and the already-at-zero branch afterwards.
+    Leaky followers never drip: the head either advanced ``updated_at`` to
+    ``created_at`` (follower elapsed = 0) or left it where a same-instant
+    drip already truncated to zero tokens (algorithms.go:361-367), so the
+    follower's drip is zero too.
+
+    Stored token status only flips to OVER on an at-zero step
+    (algorithms.go:162-169), first at rank ``q+1`` when h divides base, at
+    ``q+2`` under DRAIN_OVER_LIMIT, never otherwise; leaky has no persisted
+    status.  Leaky ``remaining_f`` keeps its fractional part through
+    integer decrements but is *exactly zeroed* by an exact-remainder step
+    (:392-397) or a drain step (:414-417).  Only the *last* follower
+    scatters state; expire/created/duration are untouched (token hits never
+    renew; leaky followers re-bump the same expiration the head wrote; a
+    uniform group can't change limit or duration after its head).
 
     Returns ``(state, resp, merged)`` where ``merged`` marks follower rows
     handled here (they're excluded from the rank rounds).
     """
     b = reqs.slot.shape[0]
     TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
     OVER = jnp.int32(Status.OVER_LIMIT)
     NO_MERGE = jnp.int32(
         Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN
@@ -235,19 +247,19 @@ def _apply_merged_followers(
         & (reqs.burst == hd(reqs.burst))
         & (reqs.algorithm == hd(reqs.algorithm))
     )
+    is_tok = reqs.algorithm == TOKEN
     # Followers must take the exists path (known & in_use & now<=expire);
     # heads are exempt from the known check (their round-0 transition
     # handles the new-item case and leaves in_use set).
     ok = (
         reqs.valid
         & same_as_head
-        & (reqs.algorithm == TOKEN)
         & (reqs.hits > 0)
         & ((reqs.behavior & NO_MERGE) == 0)
         & (reqs.known | (rank == 0))
     )
     # A group merges only if every valid member is mergeable: one bad row
-    # (different hits/limit/..., leaky, RESET) sends the whole group to the
+    # (different hits/limit/..., RESET, query) sends the whole group to the
     # rank rounds so cross-member interactions stay sequential.
     bad_per_seg = jnp.zeros(b, jnp.int32).at[seg_id].add(
         (reqs.valid & ~ok).astype(jnp.int32)
@@ -257,6 +269,8 @@ def _apply_merged_followers(
     # Post-head state of the group's slot.
     slot = reqs.slot
     R0 = state.remaining[slot]
+    F0 = state.remaining_f[slot]
+    N0 = F0.astype(jnp.int64)  # Go float64→int64 truncation
     S0 = state.status[slot]
     E = state.expire_at[slot]
     alive = now <= E
@@ -265,28 +279,56 @@ def _apply_merged_followers(
 
     h = jnp.where(reqs.hits > 0, reqs.hits, jnp.int64(1))  # div-safe
     i = rank.astype(jnp.int64)
-    q = R0 // h
+    base = jnp.where(is_tok, R0, N0)
+    q = base // h
     drain = (reqs.behavior & Behavior.DRAIN_OVER_LIMIT) != 0
     under = i <= q
-    rem_over = jnp.where(drain, jnp.int64(0), R0 - q * h)
-    rem_resp = jnp.where(under, R0 - i * h, rem_over)
+    rem_over = jnp.where(drain, jnp.int64(0), base - q * h)
+    rem_resp = jnp.where(under, base - i * h, rem_over)
+    # Leaky reset_time tracks the would-be post-step remaining: the over-ask
+    # branch reports it from the *pre*-step value, the at-zero rows that
+    # follow a drain report zero (algorithms.go:400-430).
+    safe_limit = jnp.where(reqs.limit == 0, jnp.int64(1), reqs.limit)
+    rate_i = (reqs.duration.astype(jnp.float64) / safe_limit.astype(jnp.float64)).astype(jnp.int64)
+    reset_rem = jnp.where(
+        under, rem_resp, jnp.where(drain & (i > q + 1), jnp.int64(0), base - q * h)
+    )
+    leaky_reset = reqs.created_at + (reqs.limit - reset_rem) * rate_i
     resp = RespBatch(
-        status=jnp.where(merged, jnp.where(under, S0, OVER), resp.status),
+        status=jnp.where(
+            merged,
+            jnp.where(under, jnp.where(is_tok, S0, UNDER), OVER),
+            resp.status,
+        ),
         limit=jnp.where(merged, reqs.limit, resp.limit),
         remaining=jnp.where(merged, rem_resp, resp.remaining),
-        reset_time=jnp.where(merged, E, resp.reset_time),
+        reset_time=jnp.where(
+            merged, jnp.where(is_tok, E, leaky_reset), resp.reset_time
+        ),
         over_limit=jnp.where(merged, ~under, resp.over_limit),
     )
 
     # Final state: scattered by the last follower alone.
     is_last = merged & (rank == group_size - 1)
-    divisible = R0 - q * h == 0
+    divisible = base - q * h == 0
+    # Token: stored status flips OVER once an at-zero step occurred.
     at_zero_hit = jnp.where(divisible, i > q, drain & (i > q + 1))
     status_final = jnp.where(at_zero_hit, OVER, S0)
-    scat = jnp.where(is_last, slot, capacity)
+    scat_tok = jnp.where(is_last & is_tok, slot, capacity)
+    # Leaky: the float remaining keeps its fraction through decrements but
+    # collapses to exactly 0.0 after an exact-remainder step (q ≥ 1,
+    # divisible, reached) or a drain step (base > 0, passed rank q).
+    zero_f = ((q >= 1) & divisible & (i >= q)) | ((base > 0) & drain & (i > q))
+    remf_final = jnp.where(
+        zero_f,
+        jnp.float64(0.0),
+        F0 - (jnp.minimum(i, q) * h).astype(jnp.float64),
+    )
+    scat_leaky = jnp.where(is_last & ~is_tok, slot, capacity)
     state = state._replace(
-        remaining=state.remaining.at[scat].set(rem_resp, mode="drop"),
-        status=state.status.at[scat].set(status_final, mode="drop"),
+        remaining=state.remaining.at[scat_tok].set(rem_resp, mode="drop"),
+        status=state.status.at[scat_tok].set(status_final, mode="drop"),
+        remaining_f=state.remaining_f.at[scat_leaky].set(remf_final, mode="drop"),
     )
     return state, resp, merged
 
@@ -302,13 +344,14 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
     (docs/architecture.md, benchmark_test.go:122-147).  Naive rank rounds
     cost one full gather+scatter per duplicate.  When every request in a
     slot group is *identical* (same hits/limit/duration/algorithm/behavior/
-    created_at/burst, hits>0, token bucket, no RESET/Gregorian) the
-    sequential fold over the group has a closed form in the member's rank:
-    the group head runs the normal transition (handling new-item/renewal/
-    limit-delta), every follower's response is prefix arithmetic on the
-    head's post-state, and only the last member scatters the final state.
-    Duplicate cost collapses from O(dups) rounds to O(1); mixed groups fall
-    back to rank rounds bounded by the *non-merged* ranks only.
+    created_at/burst, hits>0, token or leaky bucket, no RESET/Gregorian)
+    the sequential fold over the group has a closed form in the member's
+    rank: the group head runs the normal transition (handling new-item/
+    renewal/limit-delta/drip), every follower's response is prefix
+    arithmetic on the head's post-state, and only the last member scatters
+    the final state.  Duplicate cost collapses from O(dups) rounds to O(1);
+    mixed groups fall back to rank rounds bounded by the *non-merged* ranks
+    only.
     """
 
     def tick(state: BucketState, reqs: ReqBatch, now: jnp.ndarray):
@@ -352,8 +395,8 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
             merged = jnp.zeros(b, jnp.bool_)
 
         # Rank rounds for whatever didn't merge (mixed-parameter groups,
-        # leaky duplicates, RESET/Gregorian flows): round k applies at most
-        # one request per slot.
+        # RESET/Gregorian flows, queries): round k applies at most one
+        # request per slot.
         pending = reqs.valid & ~merged
         n_rounds = jnp.max(jnp.where(pending, rank, 0)) + 1
 

@@ -8,7 +8,8 @@ uniform duplicate groups into closed-form prefix arithmetic
 the pure rank-round kernel (merge_uniform=False) produce identical
 responses *and* identical final table state across the branch space:
 under/over, exact remainder, DRAIN_OVER_LIMIT, persisted status, mixed
-groups (fallback), leaky (never merged), RESET_REMAINING (never merged).
+groups (fallback), leaky herds (fraction preservation, exact-zero, drain),
+RESET_REMAINING (never merged).
 """
 
 import jax
@@ -23,14 +24,19 @@ from gubernator_tpu.types import Algorithm, Behavior, Status
 CAP = 256
 
 
+# Module-scoped jitted kernels: jax.jit caches per (function, shapes), and
+# make_tick_fn returns a fresh closure per call — building them once lets
+# every same-shape batch across the suite reuse one compiled program.
+FAST = jax.jit(make_tick_fn(CAP, merge_uniform=True))
+SLOW = jax.jit(make_tick_fn(CAP, merge_uniform=False))
+
+
 def run_both(m: np.ndarray, state: BucketState | None = None, now: int = 1_000):
     """Run one packed batch through the merged and unmerged kernels."""
     if state is None:
         state = BucketState.zeros(CAP)
-    fast = jax.jit(make_tick_fn(CAP, merge_uniform=True))
-    slow = jax.jit(make_tick_fn(CAP, merge_uniform=False))
-    st_f, r_f = fast(state, jnp.asarray(m), jnp.int64(now))
-    st_s, r_s = slow(state, jnp.asarray(m), jnp.int64(now))
+    st_f, r_f = FAST(state, jnp.asarray(m), jnp.int64(now))
+    st_s, r_s = SLOW(state, jnp.asarray(m), jnp.int64(now))
     return (st_f, np.asarray(r_f)), (st_s, np.asarray(r_s))
 
 
@@ -139,17 +145,116 @@ def test_mixed_hits_group_falls_back_identically():
     assert_identical(f, s)
 
 
-def test_leaky_and_reset_groups_never_merge_wrongly():
+def test_reset_and_query_groups_never_merge_wrongly():
     rows = (
-        uniform_rows(8, slot=1, hits=1, limit=10,
-                     algorithm=Algorithm.LEAKY_BUCKET)
-        + uniform_rows(8, slot=2, hits=1, limit=10,
-                       behavior=Behavior.RESET_REMAINING)
+        uniform_rows(8, slot=2, hits=1, limit=10,
+                     behavior=Behavior.RESET_REMAINING)
         + uniform_rows(8, slot=4, hits=0, limit=10)  # queries
     )
     m = packed(rows)
     f, s = run_both(m)
     assert_identical(f, s)
+
+
+def test_leaky_herd_fresh_key_drains_then_over():
+    m = packed(uniform_rows(
+        64, hits=1, limit=10, algorithm=Algorithm.LEAKY_BUCKET))
+    f, s = run_both(m)
+    assert_identical(f, s)
+    r = f[1]
+    # burst defaults to limit; head takes 1, followers drain the rest.
+    assert list(r[2][:10]) == list(range(9, -1, -1))
+    assert (r[0][:10] == Status.UNDER_LIMIT).all()
+    assert (r[0][10:64] == Status.OVER_LIMIT).all()
+    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+
+
+def test_leaky_herd_preserves_fraction_through_decrements():
+    # A stored fractional remaining (mid-drip) must survive integer
+    # decrements bit-exactly — the closed form subtracts from the float,
+    # not the truncation.
+    st = BucketState.zeros(CAP)
+    st = st._replace(
+        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
+        limit=st.limit.at[3].set(10),
+        remaining_f=st.remaining_f.at[3].set(7.625),
+        duration=st.duration.at[3].set(60_000),
+        burst=st.burst.at[3].set(10),
+        updated_at=st.updated_at.at[3].set(1_000),
+        expire_at=st.expire_at.at[3].set(61_000),
+        in_use=st.in_use.at[3].set(True),
+    )
+    m = packed(uniform_rows(4, hits=2, limit=10, known_head=1,
+                            algorithm=Algorithm.LEAKY_BUCKET))
+    f, s = run_both(m, state=st)
+    assert_identical(f, s)
+    # 7.625 → head 5.625 → followers 3.625, 1.625, then over-ask parks it.
+    assert float(np.asarray(f[0].remaining_f)[3]) == 1.625
+
+
+def test_leaky_herd_exact_remainder_zeroes_float():
+    # algorithms.go:392-397: the exact-remainder branch sets the *float*
+    # remaining to exactly 0.0, dropping any fraction.
+    st = BucketState.zeros(CAP)
+    st = st._replace(
+        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
+        limit=st.limit.at[3].set(10),
+        remaining_f=st.remaining_f.at[3].set(6.5),
+        duration=st.duration.at[3].set(60_000),
+        burst=st.burst.at[3].set(10),
+        updated_at=st.updated_at.at[3].set(1_000),
+        expire_at=st.expire_at.at[3].set(61_000),
+        in_use=st.in_use.at[3].set(True),
+    )
+    m = packed(uniform_rows(8, hits=2, limit=10, known_head=1,
+                            algorithm=Algorithm.LEAKY_BUCKET))
+    f, s = run_both(m, state=st)
+    assert_identical(f, s)
+    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+
+
+def test_leaky_herd_drain_zeroes_and_at_zero_reset_time():
+    # Non-divisible remainder + DRAIN_OVER_LIMIT: the first over-ask zeroes
+    # the float; later followers take the at-zero branch, whose reset_time
+    # is computed from zero remaining, not the parked remainder.
+    m = packed(uniform_rows(32, hits=3, limit=10,
+                            algorithm=Algorithm.LEAKY_BUCKET,
+                            behavior=Behavior.DRAIN_OVER_LIMIT))
+    f, s = run_both(m)
+    assert_identical(f, s)
+    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+
+
+def test_leaky_herd_zero_remaining_keeps_fraction():
+    # trunc(remaining)=0 with a live fraction: every follower is at-zero
+    # and the fraction must survive (no exact/drain step ever fires).
+    st = BucketState.zeros(CAP)
+    st = st._replace(
+        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
+        limit=st.limit.at[3].set(10),
+        remaining_f=st.remaining_f.at[3].set(0.875),
+        duration=st.duration.at[3].set(60_000),
+        burst=st.burst.at[3].set(10),
+        updated_at=st.updated_at.at[3].set(1_000),
+        expire_at=st.expire_at.at[3].set(61_000),
+        in_use=st.in_use.at[3].set(True),
+    )
+    m = packed(uniform_rows(6, hits=2, limit=10, known_head=1,
+                            algorithm=Algorithm.LEAKY_BUCKET))
+    f, s = run_both(m, state=st)
+    assert_identical(f, s)
+    assert float(np.asarray(f[0].remaining_f)[3]) == 0.875
+
+
+def test_leaky_herd_4096_one_key():
+    n = 4096
+    m = packed(uniform_rows(n, hits=1, limit=100,
+                            algorithm=Algorithm.LEAKY_BUCKET), b=n)
+    f, s = run_both(m)
+    assert_identical(f, s)
+    r = f[1]
+    assert (r[0][:100] == Status.UNDER_LIMIT).all()
+    assert (r[0][100:n] == Status.OVER_LIMIT).all()
 
 
 def test_negative_hits_group_falls_back():
