@@ -25,6 +25,7 @@ import time
 from typing import Dict, List, Optional
 
 from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.utils import tracing
 from gubernator_tpu.types import (
     Behavior,
     GlobalUpdate,
@@ -120,8 +121,15 @@ class GlobalManager:
 
     async def _send_hits(self, hits: List[RateLimitRequest]) -> None:
         """Group accumulated hits per owning peer and forward
-        (global.go:144-187)."""
+        (global.go:144-187).  Span parity: global.go:91 sendHits scope."""
         t0 = time.perf_counter()
+        with tracing.maybe_span("GlobalManager.sendHits", {"count": len(hits)},
+                                root=True):
+            await self._send_hits_traced(hits)
+        if self.metrics is not None:
+            self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    async def _send_hits_traced(self, hits: List[RateLimitRequest]) -> None:
         by_owner: Dict[str, tuple] = {}
         local: List[RateLimitRequest] = []
         for r in hits:
@@ -167,13 +175,19 @@ class GlobalManager:
             *(send(p, reqs) for p, reqs in by_owner.values()),
             *((apply_self(local),) if local else ()),
         )
-        if self.metrics is not None:
-            self.metrics.global_send_duration.observe(time.perf_counter() - t0)
 
     async def _broadcast(self, updates: List[RateLimitRequest]) -> None:
         """Re-read current state (hits=0 query) and push it to every other
-        peer (global.go:234-283)."""
+        peer (global.go:234-283).  Span parity: global.go:193
+        broadcastPeers scope."""
         t0 = time.perf_counter()
+        with tracing.maybe_span("GlobalManager.broadcastPeers",
+                                {"count": len(updates)}, root=True):
+            await self._broadcast_traced(updates)
+        if self.metrics is not None:
+            self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
+
+    async def _broadcast_traced(self, updates: List[RateLimitRequest]) -> None:
         queries = []
         for u in updates:
             q = RateLimitRequest(**vars(u))
@@ -210,8 +224,6 @@ class GlobalManager:
             p for p in self.instance.get_peer_list() if not p.info.is_owner
         ]
         await asyncio.gather(*(push(p) for p in peers))
-        if self.metrics is not None:
-            self.metrics.broadcast_duration.observe(time.perf_counter() - t0)
 
     async def close(self) -> None:
         self._running = False

@@ -44,7 +44,7 @@ from gubernator_tpu.types import (
     has_behavior,
     set_behavior,
 )
-from gubernator_tpu.utils import timeutil
+from gubernator_tpu.utils import timeutil, tracing
 from gubernator_tpu.utils.metrics import Metrics
 
 log = logging.getLogger("gubernator.instance")
@@ -219,7 +219,10 @@ class V1Instance:
             )
         self.metrics.concurrent_checks.inc()
         try:
-            return await self._get_rate_limits(requests)
+            with tracing.maybe_span(
+                "V1Instance.GetRateLimits", {"batch.size": len(requests)}
+            ):
+                return await self._get_rate_limits(requests)
         finally:
             self.metrics.concurrent_checks.dec()
 
@@ -343,7 +346,11 @@ class V1Instance:
         self, reqs: List[RateLimitRequest]
     ) -> List[RateLimitResponse]:
         """Non-owner GLOBAL path (gubernator.go:395-421): answer from local
-        state as if we owned it, then queue the hits for reconciliation."""
+        state as if we owned it, then queue the hits for reconciliation.
+        Span parity: gubernator.go:396 getGlobalRateLimit."""
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.add_event("getGlobalRateLimit", {"count": len(reqs)})
         clones = []
         for r in reqs:
             c = RateLimitRequest(**vars(r))
@@ -376,7 +383,18 @@ class V1Instance:
     ) -> RateLimitResponse:
         """Forward one item to its owner, ≤5 retries on timeout with fresh
         owner resolution, self-upgrading if ownership moved here
-        (gubernator.go:311-391)."""
+        (gubernator.go:311-391).  Span parity: gubernator.go:315
+        asyncRequest."""
+        with tracing.maybe_span(
+            "V1Instance.asyncRequest",
+            {"ratelimit.key": req.unique_key, "ratelimit.name": req.name,
+             "peer": peer.info.grpc_address},
+        ):
+            return await self._async_request_traced(peer, req, key)
+
+    async def _async_request_traced(
+        self, peer: PeerClient, req: RateLimitRequest, key: str
+    ) -> RateLimitResponse:
         attempts = 0
         last_err: Optional[Exception] = None
         while True:
@@ -429,14 +447,32 @@ class V1Instance:
                 f"'{MAX_BATCH_SIZE}'"
             )
         created_at = timeutil.now_ms()
+        # Continue the caller's trace: each forwarded request carries W3C
+        # TraceContext in its metadata (extracted per request, the
+        # reference's prop.Extract at gubernator.go:502-504).
+        tracer = tracing.get_tracer()
+        traced = tracing.enabled()  # skip span objects entirely when untraced
+        spans = []
         for req in requests:
+            remote = tracing.extract(req.metadata) if traced else None
+            if remote is not None:
+                spans.append(tracer.start_detached(
+                    "PeersV1.GetPeerRateLimit",
+                    {"ratelimit.key": req.unique_key,
+                     "ratelimit.name": req.name},
+                    parent=remote,
+                ))
             if has_behavior(req.behavior, Behavior.GLOBAL):
                 req.behavior = set_behavior(
                     req.behavior, Behavior.DRAIN_OVER_LIMIT, True
                 )
             if req.created_at is None or req.created_at == 0:
                 req.created_at = created_at
-        return await self._submit_local(list(requests), is_owner=True)
+        try:
+            return await self._submit_local(list(requests), is_owner=True)
+        finally:
+            for s in spans:
+                tracer.finish(s)
 
     async def update_peer_globals(self, updates: Sequence[GlobalUpdate]) -> None:
         """Install owner-pushed GLOBAL state (gubernator.go:425-459).

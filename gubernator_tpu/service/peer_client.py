@@ -35,6 +35,7 @@ from gubernator_tpu.types import (
     RateLimitResponse,
     has_behavior,
 )
+from gubernator_tpu.utils import tracing
 
 
 class ErrorRecorder:
@@ -109,7 +110,13 @@ class PeerClient:
     # ------------------------------------------------------------------
     async def get_peer_rate_limit(self, req: RateLimitRequest) -> RateLimitResponse:
         """Forward one request to this peer, batching unless the request or
-        config opts out (peer_client.go:125-161)."""
+        config opts out (peer_client.go:125-161).
+
+        The caller's trace context rides inside the request metadata (W3C
+        traceparent, peer_client.go:140-141/359-360) — injected here, while
+        the caller's span is still current, because the batched send happens
+        later on the batch-loop task where the ambient context is gone."""
+        tracing.inject(req.metadata)
         if (
             has_behavior(req.behavior, Behavior.NO_BATCHING)
             or self.behaviors.disable_batching
@@ -135,9 +142,15 @@ class PeerClient:
         msg = peers_pb.GetPeerRateLimitsReq(
             requests=[convert.req_to_pb(r) for r in reqs]
         )
+        # gRPC-level trace header for the server interceptor; per-request
+        # metadata already carries each caller's own context.
+        hdrs: dict = {}
+        tracing.inject(hdrs)
         try:
             out = await stub.GetPeerRateLimits(
-                msg, timeout=self.behaviors.batch_timeout
+                msg,
+                timeout=self.behaviors.batch_timeout,
+                metadata=tuple(hdrs.items()) or None,
             )
         except grpc.aio.AioRpcError as e:
             self.last_errs.record(
@@ -204,11 +217,21 @@ class PeerClient:
 
     async def _send_batch(self, batch: List[tuple]) -> None:
         """One RPC for the whole window; distribute ordered responses, or
-        fail every waiter (peer_client.go:341-404)."""
+        fail every waiter (peer_client.go:341-404).  Span parity:
+        peer_client.go:351 sendBatch."""
         t0 = time.perf_counter()
         reqs = [r for r, _ in batch]
         try:
-            out = await self.get_peer_rate_limits(reqs)
+            # root=True: this runs on the batch-loop task, whose ambient
+            # context is whatever request first created the loop — per-item
+            # trace continuity rides the request metadata instead.
+            with tracing.maybe_span(
+                "PeerClient.sendBatch",
+                {"batch.size": len(batch),
+                 "peer": self._info.grpc_address},
+                root=True,
+            ):
+                out = await self.get_peer_rate_limits(reqs)
         except Exception as e:
             for _, fut in batch:
                 if not fut.done():

@@ -33,6 +33,7 @@ from gubernator_tpu.transport import convert
 from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
 from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
 from gubernator_tpu.types import GlobalUpdate, PeerInfo
+from gubernator_tpu.utils import tracing
 from gubernator_tpu.utils.metrics import CONTENT_TYPE_LATEST, Metrics
 
 log = logging.getLogger("gubernator.daemon")
@@ -69,6 +70,34 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
                 metrics.grpc_request_counts.labels(
                     status="failed" if failed else "success", method=method
                 ).inc()
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+class _TraceInterceptor(grpc.aio.ServerInterceptor):
+    """Server span per RPC, continuing a caller's trace when the gRPC
+    request metadata carries a W3C ``traceparent`` header (the reference's
+    otelgrpc server stats handler, daemon.go:125)."""
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = handler_call_details.method
+        parent = tracing.extract(
+            {k: v for k, v in (handler_call_details.invocation_metadata or ())
+             if isinstance(v, str)}
+        )
+        inner = handler.unary_unary
+
+        async def wrapped(request, context):
+            with tracing.maybe_span(f"grpc.recv{method.replace('/', '.')}",
+                                    parent=parent):
+                return await inner(request, context)
 
         return grpc.unary_unary_rpc_method_handler(
             wrapped,
@@ -156,7 +185,7 @@ class Daemon:
         """Bring up instance, gRPC, gateway, discovery (daemon.go:83-366)."""
         self.tls = setup_tls(self.conf.tls)
         server = grpc.aio.server(
-            interceptors=[_StatsInterceptor(self.metrics)],
+            interceptors=[_StatsInterceptor(self.metrics), _TraceInterceptor()],
             options=[
                 ("grpc.max_receive_message_length", MAX_RECV_BYTES),
                 ("grpc.max_connection_age_ms", 60 * 60 * 1000),
@@ -257,9 +286,14 @@ class Daemon:
         except json_format.ParseError as e:
             return web.json_response({"error": str(e), "code": 3}, status=400)
         try:
-            out = await self.instance.get_rate_limits(
-                convert.reqs_from_pb(msg.requests)
+            parent = tracing.extract(
+                {k.lower(): v for k, v in request.headers.items()}
             )
+            with tracing.maybe_span("http.recv./v1/GetRateLimits",
+                                    parent=parent):
+                out = await self.instance.get_rate_limits(
+                    convert.reqs_from_pb(msg.requests)
+                )
         except BatchTooLargeError as e:
             return web.json_response({"error": str(e), "code": 11}, status=400)
         resp = pb.GetRateLimitsResp(responses=convert.resps_to_pb(out))
@@ -400,7 +434,11 @@ class DaemonClient:
 
     async def get_rate_limits(self, reqs, timeout: float = 5.0):
         msg = pb.GetRateLimitsReq(requests=[convert.req_to_pb(r) for r in reqs])
-        out = await self.stub.GetRateLimits(msg, timeout=timeout)
+        hdrs: dict = {}
+        tracing.inject(hdrs)
+        out = await self.stub.GetRateLimits(
+            msg, timeout=timeout, metadata=tuple(hdrs.items()) or None
+        )
         return [convert.resp_from_pb(r) for r in out.responses]
 
     async def health_check(self, timeout: float = 5.0):

@@ -1,0 +1,194 @@
+"""Tracing: span lifecycle, W3C TraceContext codec, and cross-peer
+propagation through a live cluster.
+
+The reference piggybacks trace context on ``RateLimitReq.Metadata``
+(metadata_carrier.go:19-38, injected at peer_client.go:140-141/359-360,
+extracted at gubernator.go:502-504) so a forwarded request's owner-side
+work reports into the caller's trace.  The cluster test here proves the
+same end to end: a traced client call through a non-owner daemon produces
+owner-side spans with the client's trace id.
+"""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.types import Behavior, RateLimitRequest
+from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils.tracing import InMemoryExporter, SpanContext, Tracer
+
+
+# ---------------------------------------------------------------------
+# Unit: codec + span tree
+# ---------------------------------------------------------------------
+def test_traceparent_round_trip():
+    t = Tracer()
+    exp = InMemoryExporter()
+    t.exporters.append(exp)
+    carrier = {}
+    with t.span("root") as root:
+        t.inject(carrier)
+    ctx = t.extract(carrier)
+    assert ctx is not None
+    assert ctx.trace_id == root.trace_id
+    assert ctx.span_id == root.span_id
+    assert ctx.sampled
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "garbage",
+        "00-abc-def-01",                                     # wrong lengths
+        "00-" + "0" * 32 + "-" + "1234567890abcdef" + "-01",  # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",            # zero span id
+        "ff-" + "1" * 32 + "-" + "1234567890abcdef" + "-01",  # version ff
+        "00-" + "G" * 32 + "-" + "1234567890abcdef" + "-01",  # non-hex
+    ],
+)
+def test_traceparent_malformed_rejected(bad):
+    assert Tracer.extract({"traceparent": bad}) is None
+
+
+def test_span_nesting_and_export():
+    t = Tracer()
+    exp = InMemoryExporter()
+    t.exporters.append(exp)
+    with t.span("outer") as outer:
+        with t.span("inner", {"k": "v"}) as inner:
+            assert t.current_span() is inner
+        assert t.current_span() is outer
+    assert t.current_span() is None
+    names = [s.name for s in exp.spans]
+    assert names == ["inner", "outer"]  # inner finishes first
+    inner_s, outer_s = exp.spans
+    assert inner_s.trace_id == outer_s.trace_id
+    assert inner_s.parent_span_id == outer_s.span_id
+    assert inner_s.attributes["k"] == "v"
+    assert inner_s.duration_ms >= 0
+
+
+def test_remote_parent_continues_trace():
+    t = Tracer()
+    remote = SpanContext("ab" * 16, "cd" * 8)
+    with t.span("server", parent=remote) as s:
+        assert s.trace_id == remote.trace_id
+        assert s.parent_span_id == remote.span_id
+
+
+def test_detached_spans_do_not_become_current():
+    t = Tracer()
+    exp = InMemoryExporter()
+    t.exporters.append(exp)
+    remote = SpanContext("12" * 16, "34" * 8)
+    s = t.start_detached("batch-item", parent=remote)
+    assert t.current_span() is None
+    t.finish(s)
+    assert exp.spans[0].trace_id == remote.trace_id
+
+
+def test_sampling_off_propagates_but_records_nothing():
+    t = Tracer(ratio=0.0)
+    exp = InMemoryExporter()
+    t.exporters.append(exp)
+    carrier = {}
+    with t.span("unsampled") as s:
+        assert not s.context.sampled
+        t.inject(carrier)
+    assert len(exp.spans) == 0
+    # Context still crosses the wire, flags=00 (W3C requires propagation).
+    ctx = t.extract(carrier)
+    assert ctx is not None and not ctx.sampled
+
+
+def test_exception_recorded():
+    t = Tracer()
+    exp = InMemoryExporter()
+    t.exporters.append(exp)
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            raise ValueError("nope")
+    assert "ValueError: nope" in exp.spans[0].error
+
+
+# ---------------------------------------------------------------------
+# Cluster: trace id survives a forwarded request
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def event_loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def cluster(event_loop):
+    c = event_loop.run_until_complete(Cluster.start(3))
+    yield c
+    event_loop.run_until_complete(c.stop())
+
+
+@pytest.fixture()
+def exporter():
+    exp = InMemoryExporter()
+    tracing.add_exporter(exp)
+    yield exp
+    tracing.remove_exporter(exp)
+
+
+async def test_trace_id_survives_forwarding(cluster, exporter):
+    """Client span → non-owner daemon → owner daemon: every hop's spans
+    carry the client's trace id (the in-process cluster shares one
+    exporter, so both daemons' spans land in it)."""
+    non_owner = cluster.list_non_owning_daemons("traced", "tk")[0]
+    client = non_owner.client()
+    with tracing.span("client.call") as client_span:
+        out = await client.get_rate_limits(
+            [RateLimitRequest(name="traced", unique_key="tk", hits=1,
+                              limit=5, duration=60_000)]
+        )
+    assert out[0].error == ""
+    await client.close()
+
+    trace = exporter.by_trace(client_span.trace_id)
+    names = {s.name for s in trace}
+    # Non-owner side: server RPC span + the forward span.
+    assert "grpc.recv.pb.gubernator.V1.GetRateLimits" in names
+    assert "V1Instance.asyncRequest" in names
+    # Owner side: the peer handler continued the trace from the request
+    # metadata (gubernator.go:502-504 parity).
+    assert "PeersV1.GetPeerRateLimit" in names
+    peer_span = next(s for s in trace if s.name == "PeersV1.GetPeerRateLimit")
+    assert peer_span.attributes["ratelimit.key"] == "tk"
+
+
+async def test_no_batching_forward_also_propagates(cluster, exporter):
+    non_owner = cluster.list_non_owning_daemons("traced-nb", "tk2")[0]
+    client = non_owner.client()
+    with tracing.span("client.call.nb") as client_span:
+        out = await client.get_rate_limits(
+            [RateLimitRequest(name="traced-nb", unique_key="tk2", hits=1,
+                              limit=5, duration=60_000,
+                              behavior=Behavior.NO_BATCHING)]
+        )
+    assert out[0].error == ""
+    await client.close()
+    names = {s.name for s in exporter.by_trace(client_span.trace_id)}
+    assert "PeersV1.GetPeerRateLimit" in names
+
+
+async def test_untraced_request_starts_fresh_traces(cluster, exporter):
+    """No client context → server spans are roots (no parent leakage)."""
+    d = cluster.daemons[0]
+    client = d.client()
+    out = await client.get_rate_limits(
+        [RateLimitRequest(name="untraced", unique_key="u1", hits=1,
+                          limit=5, duration=60_000)]
+    )
+    assert out[0].error == ""
+    await client.close()
+    rpc_spans = exporter.by_name("grpc.recv.pb.gubernator.V1.GetRateLimits")
+    assert rpc_spans, "server RPC span missing"
+    assert all(s.parent_span_id is None for s in rpc_spans)
