@@ -78,22 +78,34 @@ def rung_kernel():
     m[rows["created_at"]] = now
     m[rows["valid"]] = 1
 
-    tick = jax.jit(make_tick_fn(capacity), donate_argnums=(0,))
+    from jax import lax
+
+    tick = make_tick_fn(capacity)
+    iters = 100
+
+    # Chain `iters` ticks inside ONE compiled program: measures the chip,
+    # not the dispatch path — the tunneled device's per-call latency (and
+    # its intermittent refusal to pipeline async dispatches) can't touch an
+    # on-device fori_loop.
+    @jax.jit
+    def run_chain(state, packed):
+        def body(i, carry):
+            st, _ = carry
+            return tick(st, packed, jnp.int64(now) + i)
+
+        return lax.fori_loop(
+            0, iters, body, (state, jnp.zeros((5, batch), jnp.int64))
+        )
+
     state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
     packed = jnp.asarray(m)
-
-    state, resp = tick(state, packed, jnp.int64(now))
+    st, resp = run_chain(state, packed)  # compile + warm
     jax.block_until_ready(resp)
 
-    # Best of several trial windows: the tunneled device sometimes stops
-    # pipelining async dispatches for a while, which measures the tunnel,
-    # not the chip.  The max over windows is the honest device ceiling.
-    iters = 50
     best = 0.0
-    for trial in range(5):
+    for trial in range(3):
         t0 = time.perf_counter()
-        for i in range(iters):
-            state, resp = tick(state, packed, jnp.int64(now + i))
+        st, resp = run_chain(st, packed)
         jax.block_until_ready(resp)
         dt = time.perf_counter() - t0
         best = max(best, batch * iters / dt)
@@ -244,7 +256,10 @@ async def _service_bench(n_batches, batch, concurrency):
         http_listen_address="",
         peer_discovery_type="none",
     )
-    conf.config = Config(behaviors=BehaviorConfig(), cache_size=1 << 17)
+    # 2^20 matches the leaky rung's table so the daemon's engine reuses the
+    # already-compiled tick program instead of paying a fresh XLA compile
+    # (a new capacity = a new program; compiles run minutes on slow hosts).
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=1 << 20)
     d = await spawn_daemon(conf)
     client = DaemonClient(d.advertise_address)
     rng = np.random.default_rng(3)

@@ -807,10 +807,17 @@ class TickEngine:
     def _warmup(self) -> None:
         """Compile the tick/install programs now (first compile is seconds;
         it must land at startup, not on the first live request's deadline).
-        An all-padding batch leaves the zeroed state untouched."""
+        An all-padding batch leaves the zeroed state untouched.
+
+        The response matrix is materialized host-side too: the first D2H of
+        a given buffer shape pays a setup cost on tunneled devices (~1.5s
+        measured) — unwarmed, that lands on the first live request, blows
+        the 500ms peer batch_timeout, and triggers forward retries that
+        double-count hits."""
         m = np.zeros((len(REQ_ROWS), self.max_batch), np.int64)
         m[REQ_ROW_INDEX["slot"]] = self.capacity
-        self.state, _ = self._tick(self.state, jnp.asarray(m), jnp.int64(0))
+        self.state, resp = self._tick(self.state, jnp.asarray(m), jnp.int64(0))
+        np.asarray(resp)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
         jax.block_until_ready(self.state)

@@ -425,10 +425,11 @@ class MeshGlobalEngine:
     def _warmup(self) -> None:
         m = np.zeros((self.n_nodes, len(REQ_ROWS), self.max_batch), np.int64)
         m[:, REQ_ROW_INDEX["slot"], :] = self.capacity
-        self.state, self.aux, self.accum, _ = self._proc(
+        self.state, self.aux, self.accum, resp = self._proc(
             self.state, self.aux, self.accum,
             jax.device_put(m, self._req_sharding), jnp.int64(0), jnp.int64(0),
         )
+        np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
         self.state, self.accum = self._recon(
             self.state, self.aux, self.accum, jnp.int64(0)
         )

@@ -144,7 +144,8 @@ class MeshTickEngine:
         reqs_dev = jax.device_put(
             m, NamedSharding(self.mesh, P("shard", None, None))
         )
-        self.state, _ = self._tick(self.state, reqs_dev, jnp.int64(0))
+        self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(0))
+        np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
         jax.block_until_ready(self.state)

@@ -87,6 +87,8 @@ class _TraceInterceptor(grpc.aio.ServerInterceptor):
         handler = await continuation(handler_call_details)
         if handler is None or handler.unary_unary is None:
             return handler
+        if not tracing.enabled():  # per-RPC check: dynamic enable still works
+            return handler
         method = handler_call_details.method
         parent = tracing.extract(
             {k: v for k, v in (handler_call_details.invocation_metadata or ())

@@ -35,7 +35,12 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Sequence
 
 TRACEPARENT = "traceparent"
-_TP_RE = re.compile(r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+# W3C trace-context: version 00 is exactly 4 fields; a higher version may
+# append fields after the flags, and receivers must parse the first four
+# and ignore the rest (the spec's forward-compatibility rule).
+_TP_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})($|-)"
+)
 
 FLAG_SAMPLED = 0x01
 
@@ -144,8 +149,14 @@ class OtelBridgeExporter(SpanExporter):
         # Import here: constructing the bridge without the SDK should fail
         # loudly at install time, not silently per span.
         from opentelemetry.sdk.trace import ReadableSpan  # noqa: F401
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.util.instrumentation import InstrumentationScope
 
         self._exporter = otel_span_exporter
+        # Real SDK encoders dereference resource/scope attributes — they
+        # must be concrete objects, not None; both are per-process constants.
+        self._resource = Resource.create({"service.name": "gubernator-tpu"})
+        self._scope = InstrumentationScope("gubernator_tpu")
 
     def export(self, span: Span) -> None:
         from opentelemetry import trace as ot
@@ -170,6 +181,8 @@ class OtelBridgeExporter(SpanExporter):
             name=span.name,
             context=ctx,
             parent=parent,
+            resource=self._resource,
+            instrumentation_scope=self._scope,
             attributes=dict(span.attributes),
             start_time=span.start_ns,
             end_time=span.end_ns,
@@ -316,9 +329,11 @@ class Tracer:
         m = _TP_RE.match(metadata.get(TRACEPARENT, ""))
         if not m:
             return None
-        version, trace_id, span_id, flags = m.groups()
+        version, trace_id, span_id, flags, tail = m.groups()
         if version == "ff" or int(trace_id, 16) == 0 or int(span_id, 16) == 0:
             return None
+        if version == "00" and tail:
+            return None  # version 00 allows no trailing fields
         return SpanContext(trace_id, span_id, int(flags, 16))
 
 

@@ -1,0 +1,410 @@
+"""Discovery pool tests: gossip membership, etcd, DNS, and k8s pools.
+
+The reference exercises its pools against real infra in CI
+(memberlist/etcd containers); here each pool runs against in-process
+equivalents: the gossip pool against its own peers on loopback, the
+etcd pool against a stub speaking the v3 JSON gateway (the surface
+etcd.go drives), the k8s pool against a stub API server, and the DNS
+pool against the system resolver on ``localhost``.
+"""
+
+import asyncio
+import base64
+import contextlib
+import json
+
+import pytest
+from aiohttp import web
+
+from gubernator_tpu.discovery import etcdpool
+from gubernator_tpu.discovery.dnspool import DNSPool
+from gubernator_tpu.discovery.etcdpool import EtcdPool
+from gubernator_tpu.discovery.gossip import MemberlistPool
+from gubernator_tpu.discovery.k8spool import K8sPool
+from gubernator_tpu.types import PeerInfo
+
+
+async def wait_until(predicate, timeout=8.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        if predicate():
+            return
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met before timeout")
+        await asyncio.sleep(interval)
+
+
+# ---------------------------------------------------------------------
+# Gossip (memberlist equivalent)
+# ---------------------------------------------------------------------
+def _free_addr():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return addr
+
+
+def _gossip_node(addr, seeds, updates, interval=0.05):
+    return MemberlistPool(
+        bind_address=addr,
+        known_nodes=seeds,
+        info=PeerInfo(grpc_address=f"grpc-{addr}"),
+        on_update=updates.append,
+        gossip_interval=interval,
+        suspect_after=3,
+    )
+
+
+async def test_gossip_three_node_join_death_and_leave():
+    addrs = [_free_addr() for _ in range(3)]
+    updates = [[] for _ in range(3)]
+    pools = [
+        _gossip_node(a, [addrs[0]] if i else [], updates[i])
+        for i, a in enumerate(addrs)
+    ]
+    for p in pools:
+        await p.start()
+    try:
+        # Transitive join: node 2 learns node 1 via the shared seed.
+        await wait_until(
+            lambda: all(u and len(u[-1]) == 3 for u in updates)
+        )
+        peers = {p.grpc_address for p in updates[0][-1]}
+        assert peers == {f"grpc-{a}" for a in addrs}
+
+        # Hard-kill node 2 (no graceful leave): failure detection must mark
+        # it dead after suspect_after failed probes.
+        pools[2]._task.cancel()
+        pools[2]._server.close()
+        await pools[2]._server.wait_closed()
+        await wait_until(lambda: len(updates[0][-1]) == 2)
+        assert f"grpc-{addrs[2]}" not in {
+            p.grpc_address for p in updates[0][-1]
+        }
+
+        # Graceful leave: node 1 announces its own death on close.
+        await pools[1].close()
+        await wait_until(lambda: len(updates[0][-1]) == 1)
+    finally:
+        for p in (pools[0],):
+            await p.close()
+
+
+async def test_gossip_swim_refutation():
+    """A falsely-accused node re-asserts itself with a higher incarnation
+    (SWIM refutation, the memberlist behavior gossip.py:81-86 mirrors)."""
+    a_addr, b_addr = _free_addr(), _free_addr()
+    a_updates, b_updates = [], []
+    a = _gossip_node(a_addr, [], a_updates)
+    b = _gossip_node(b_addr, [a_addr], b_updates)
+    await a.start()
+    await b.start()
+    try:
+        await wait_until(
+            lambda: b_updates and len(b_updates[-1]) == 2
+            and a_updates and len(a_updates[-1]) == 2
+        )
+        # B wrongly believes A is dead (same incarnation: dead beats alive).
+        rec = b._members[a_addr]
+        rec["alive"] = False
+        b._emit()
+        assert len(b_updates[-1]) == 1
+        # Gossip reaches A; A refutes; B relearns A alive.
+        await wait_until(lambda: len(b_updates[-1]) == 2)
+        assert b._members[a_addr]["alive"]
+        assert (
+            b._members[a_addr]["incarnation"]
+            > rec["incarnation"] - 1
+        )
+    finally:
+        await a.close()
+        await b.close()
+
+
+# ---------------------------------------------------------------------
+# etcd pool against a stub v3 JSON gateway
+# ---------------------------------------------------------------------
+class EtcdStub:
+    """In-memory etcd v3 gateway: leases + kv under one prefix."""
+
+    def __init__(self):
+        self.kv = {}          # key(bytes-str) -> (value b64, lease_id)
+        self.leases = set()
+        self.next_lease = 100
+        self.fail_keepalive_once = False
+        self.puts = 0
+
+    def app(self):
+        app = web.Application()
+        app.router.add_post("/v3/lease/grant", self.lease_grant)
+        app.router.add_post("/v3/lease/keepalive", self.lease_keepalive)
+        app.router.add_post("/v3/lease/revoke", self.lease_revoke)
+        app.router.add_post("/v3/kv/put", self.kv_put)
+        app.router.add_post("/v3/kv/range", self.kv_range)
+        app.router.add_post("/v3/kv/deleterange", self.kv_delete)
+        return app
+
+    async def lease_grant(self, req):
+        self.next_lease += 1
+        self.leases.add(self.next_lease)
+        return web.json_response({"ID": str(self.next_lease), "TTL": "30"})
+
+    async def lease_keepalive(self, req):
+        body = await req.json()
+        lease = int(body["ID"])
+        if self.fail_keepalive_once or lease not in self.leases:
+            self.fail_keepalive_once = False
+            # Lease gone: etcd reports TTL 0 and the key vanishes.
+            self.leases.discard(lease)
+            self.kv = {k: v for k, v in self.kv.items() if v[1] != lease}
+            return web.json_response({"result": {"TTL": "0"}})
+        return web.json_response({"result": {"TTL": "30"}})
+
+    async def lease_revoke(self, req):
+        body = await req.json()
+        self.leases.discard(int(body["ID"]))
+        return web.json_response({})
+
+    async def kv_put(self, req):
+        body = await req.json()
+        self.kv[body["key"]] = (body["value"], int(body.get("lease", 0)))
+        self.puts += 1
+        return web.json_response({})
+
+    async def kv_range(self, req):
+        body = await req.json()
+        lo = base64.b64decode(body["key"])
+        hi = base64.b64decode(body["range_end"])
+        kvs = [
+            {"key": k, "value": v}
+            for k, (v, _lease) in self.kv.items()
+            if lo <= base64.b64decode(k) < hi
+        ]
+        return web.json_response({"kvs": kvs})
+
+    async def kv_delete(self, req):
+        body = await req.json()
+        self.kv.pop(body["key"], None)
+        return web.json_response({})
+
+
+@contextlib.asynccontextmanager
+async def serve(app):
+    """Run an aiohttp app on an ephemeral port inside the test's loop
+    (async fixtures need pytest-asyncio, which the image doesn't ship)."""
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        yield f"127.0.0.1:{port}"
+    finally:
+        await runner.cleanup()
+
+
+async def test_etcd_register_watch_and_close():
+    stub = EtcdStub()
+    async with serve(stub.app()) as endpoint:
+        await _etcd_register_watch_and_close(stub, endpoint)
+
+
+async def _etcd_register_watch_and_close(stub, endpoint):
+    updates = []
+    pool = EtcdPool(
+        endpoints=[endpoint],
+        key_prefix="/guber/peers/",
+        info=PeerInfo(grpc_address="10.0.0.1:81", http_address="10.0.0.1:80"),
+        on_update=updates.append,
+        poll_interval=0.05,
+    )
+    await pool.start()
+    try:
+        await wait_until(lambda: updates)
+        assert updates[-1] == [
+            PeerInfo(grpc_address="10.0.0.1:81", http_address="10.0.0.1:80")
+        ]
+        # A second node appears in the prefix → emitted.
+        key = base64.b64encode(b"/guber/peers/10.0.0.2:81").decode()
+        val = base64.b64encode(
+            json.dumps({"grpc_address": "10.0.0.2:81"}).encode()
+        ).decode()
+        stub.kv[key] = (val, 0)
+        await wait_until(lambda: len(updates[-1]) == 2)
+    finally:
+        await pool.close()
+    # Close deleted our key and revoked the lease (etcd.go shutdown).
+    assert all(b"10.0.0.1" not in base64.b64decode(k) for k in stub.kv)
+    assert not stub.leases
+
+
+async def test_etcd_lease_loss_triggers_reregister(monkeypatch):
+    stub = EtcdStub()
+    # Shrink the keepalive cadence (LEASE_TTL_S/3 sleeps) for the test.
+    monkeypatch.setattr(etcdpool, "LEASE_TTL_S", 0.3)
+    async with serve(stub.app()) as endpoint:
+        await _etcd_lease_loss(stub, endpoint)
+
+
+async def _etcd_lease_loss(stub, endpoint):
+    updates = []
+    pool = EtcdPool(
+        endpoints=[endpoint],
+        key_prefix="/guber/peers/",
+        info=PeerInfo(grpc_address="10.0.0.1:81"),
+        on_update=updates.append,
+        poll_interval=0.05,
+    )
+    await pool.start()
+    try:
+        await wait_until(lambda: stub.puts >= 1)
+        first_lease = pool._lease_id
+        stub.fail_keepalive_once = True  # lease dies server-side
+        # The pool must notice (TTL=0) and re-register under a new lease.
+        await wait_until(lambda: stub.puts >= 2 and pool._lease_id != first_lease)
+        assert pool._lease_id in stub.leases
+        # And the key is back despite the lease loss having dropped it.
+        await wait_until(
+            lambda: updates and updates[-1]
+            and updates[-1][0].grpc_address == "10.0.0.1:81"
+        )
+    finally:
+        await pool.close()
+
+
+# ---------------------------------------------------------------------
+# DNS pool (system resolver, localhost)
+# ---------------------------------------------------------------------
+async def test_dns_pool_resolves_and_emits_once():
+    updates = []
+    pool = DNSPool(
+        fqdn="localhost",
+        grpc_port=1051,
+        http_port=1050,
+        on_update=updates.append,
+        poll_interval=0.05,
+    )
+    await pool.start()
+    try:
+        await wait_until(lambda: updates)
+        addrs = {p.grpc_address for p in updates[-1]}
+        assert "127.0.0.1:1051" in addrs
+        assert all(p.http_address.endswith(":1050") for p in updates[-1])
+        # Stable records → no duplicate emissions across repolls.
+        await asyncio.sleep(0.3)
+        assert len(updates) == 1
+    finally:
+        await pool.close()
+
+
+def test_dns_pool_requires_fqdn():
+    with pytest.raises(ValueError):
+        DNSPool(fqdn="", grpc_port=1, http_port=1, on_update=lambda p: None)
+
+
+# ---------------------------------------------------------------------
+# k8s pool against a stub API server
+# ---------------------------------------------------------------------
+class K8sStub:
+    def __init__(self):
+        self.endpoints_ips = ["10.1.0.1", "10.1.0.2"]
+        self.pods = [
+            {"status": {"phase": "Running", "podIP": "10.1.0.1",
+                        "conditions": [{"type": "Ready", "status": "True"}]}},
+            {"status": {"phase": "Running", "podIP": "10.1.0.9",
+                        "conditions": [{"type": "Ready", "status": "False"}]}},
+            {"status": {"phase": "Pending", "podIP": "10.1.0.8",
+                        "conditions": [{"type": "Ready", "status": "True"}]}},
+        ]
+        self.selector_seen = None
+
+    def app(self):
+        app = web.Application()
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/endpoints", self.endpoints
+        )
+        app.router.add_get("/api/v1/namespaces/{ns}/pods", self.list_pods)
+        return app
+
+    async def endpoints(self, req):
+        self.selector_seen = req.query.get("labelSelector")
+        return web.json_response({
+            "items": [{
+                "subsets": [{
+                    "addresses": [{"ip": ip} for ip in self.endpoints_ips]
+                }]
+            }]
+        })
+
+    async def list_pods(self, req):
+        return web.json_response({"items": self.pods})
+
+
+async def test_k8s_endpoints_mechanism():
+    stub = K8sStub()
+    async with serve(stub.app()) as endpoint:
+        await _k8s_endpoints(stub, f"http://{endpoint}")
+
+
+async def _k8s_endpoints(stub, base):
+    updates = []
+    pool = K8sPool(
+        namespace="default",
+        selector="app=gubernator",
+        pod_ip="10.1.0.1",
+        pod_port="1051",
+        on_update=updates.append,
+        mechanism="endpoints",
+        poll_interval=0.05,
+        api_server=base,
+    )
+    await pool.start()
+    try:
+        await wait_until(lambda: updates)
+        assert {p.grpc_address for p in updates[-1]} == {
+            "10.1.0.1:1051", "10.1.0.2:1051"
+        }
+        assert stub.selector_seen == "app=gubernator"
+        # Membership change → one new emission.
+        stub.endpoints_ips.append("10.1.0.3")
+        await wait_until(lambda: len(updates[-1]) == 3)
+    finally:
+        await pool.close()
+
+
+async def test_k8s_pods_mechanism_filters_not_ready():
+    stub = K8sStub()
+    async with serve(stub.app()) as endpoint:
+        await _k8s_pods(stub, f"http://{endpoint}")
+
+
+async def _k8s_pods(stub, base):
+    updates = []
+    pool = K8sPool(
+        namespace="default",
+        selector="app=gubernator",
+        pod_ip="10.1.0.1",
+        pod_port="1051",
+        on_update=updates.append,
+        mechanism="pods",
+        poll_interval=0.05,
+        api_server=base,
+    )
+    await pool.start()
+    try:
+        await wait_until(lambda: updates)
+        # Only the Running+Ready pod appears.
+        assert [p.grpc_address for p in updates[-1]] == ["10.1.0.1:1051"]
+    finally:
+        await pool.close()
+
+
+def test_k8s_rejects_unknown_mechanism():
+    with pytest.raises(ValueError):
+        K8sPool(
+            namespace="d", selector="s", pod_ip="", pod_port="1",
+            on_update=lambda p: None, mechanism="nope",
+        )

@@ -192,3 +192,13 @@ async def test_untraced_request_starts_fresh_traces(cluster, exporter):
     rpc_spans = exporter.by_name("grpc.recv.pb.gubernator.V1.GetRateLimits")
     assert rpc_spans, "server RPC span missing"
     assert all(s.parent_span_id is None for s in rpc_spans)
+
+
+def test_traceparent_future_version_with_trailing_fields_accepted():
+    # W3C forward compatibility: higher versions may append fields; parse
+    # the first four and ignore the rest.  Version 00 allows no tail.
+    tid, sid = "1" * 32, "1234567890abcdef"
+    assert Tracer.extract(
+        {"traceparent": f"01-{tid}-{sid}-01-extradata"}
+    ) == SpanContext(tid, sid, 1)
+    assert Tracer.extract({"traceparent": f"00-{tid}-{sid}-01-extra"}) is None
