@@ -13,6 +13,7 @@ the exact peer that owns a key.
 
 from __future__ import annotations
 
+import asyncio
 import random
 from typing import Dict, List, Optional, Sequence
 
@@ -154,6 +155,46 @@ class Cluster:
     # Metrics oracle: scrape one daemon's registry value
     # (the reference scrapes /metrics; same idea, in-process).
     def metric_value(self, idx: int, name: str, labels: Dict[str, str] = None):
-        return self.daemons[idx].metrics.registry.get_sample_value(
-            name, labels or {}
+        return self.daemons[idx].metrics.sample(name, labels)
+
+    async def wait_for_metric(
+        self,
+        idx: int,
+        name: str,
+        minimum: float = 1.0,
+        labels: Dict[str, str] = None,
+        timeout: float = 5.0,
+    ) -> float:
+        """Poll one daemon's registry until ``name`` reaches ``minimum``
+        — the metrics-as-oracle pattern the reference's distributed tests
+        use instead of sleeps (functional_test.go:2184-2276)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            v = self.metric_value(idx, name, labels)
+            if v >= minimum:
+                return v
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError(
+                    f"metric {name}{labels or ''} on daemon {idx} stuck at"
+                    f" {v}, wanted >= {minimum}"
+                )
+            await asyncio.sleep(0.01)
+
+    async def wait_for_broadcast(
+        self, idx: int, count: float = 1.0, timeout: float = 5.0
+    ) -> float:
+        """Wait until daemon ``idx`` (a GLOBAL owner) has completed
+        ``count`` peer broadcasts (functional_test.go:2184 waitForBroadcast)."""
+        return await self.wait_for_metric(
+            idx, "gubernator_broadcast_duration_count", count, timeout=timeout
+        )
+
+    async def wait_for_update(
+        self, idx: int, count: float = 1.0, timeout: float = 5.0
+    ) -> float:
+        """Wait until daemon ``idx`` (a non-owner) has flushed ``count``
+        GLOBAL hit batches to the owner (functional_test.go:2230
+        waitForUpdate; ours counts send flushes via global_send_duration)."""
+        return await self.wait_for_metric(
+            idx, "gubernator_global_send_duration_count", count, timeout=timeout
         )

@@ -93,6 +93,35 @@ class Config:
             self.cache_size = 50_000
 
 
+# Metric-collector flags (reference flags.go:20-23).  "os" registers a
+# process collector (RSS, fds, CPU via /proc); "golang" — kept under the
+# reference's name so GUBER_METRIC_FLAGS values carry over — registers the
+# host-runtime collectors (here: Python GC + platform info, the analog of
+# Go's GoCollector).
+FLAG_OS_METRICS = 1 << 0
+FLAG_RUNTIME_METRICS = 1 << 1
+
+
+def parse_metric_flags(values: List[str]) -> int:
+    """Comma-separated flag names → bitmask (reference flags.go:38-57:
+    getEnvMetricFlags; invalid names are logged and ignored)."""
+    flags = 0
+    for f in values:
+        f = f.strip().lower()
+        if not f:
+            continue
+        if f == "os":
+            flags |= FLAG_OS_METRICS
+        elif f in ("golang", "python", "runtime"):
+            flags |= FLAG_RUNTIME_METRICS
+        else:
+            log.error(
+                "invalid flag '%s' for 'GUBER_METRIC_FLAGS' valid options"
+                " are ['os', 'golang']", f,
+            )
+    return flags
+
+
 @dataclass
 class TLSSettings:
     """TLS file paths / modes (reference config.go:330-420 env surface)."""
@@ -324,7 +353,7 @@ def setup_daemon_config(
         data_center=r.str_("GUBER_DATA_CENTER"),
         log_level=r.str_("GUBER_LOG_LEVEL", "info"),
         log_format=r.str_("GUBER_LOG_FORMAT", "text"),
-        metric_flags=r.int_("GUBER_METRIC_FLAGS", 0),
+        metric_flags=parse_metric_flags(r.list_("GUBER_METRIC_FLAGS")),
         memberlist_address=r.str_("GUBER_MEMBERLIST_ADDRESS"),
         memberlist_advertise_address=r.str_("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
         memberlist_known_nodes=r.list_("GUBER_MEMBERLIST_KNOWN_NODES"),

@@ -167,6 +167,8 @@ class Daemon:
                  global_mesh_node: int = 0):
         self.conf = conf
         self.metrics = Metrics()
+        # Optional OS / runtime collectors (daemon.go:276-287).
+        self.metrics.register_flag_collectors(conf.metric_flags)
         self.instance: Optional[V1Instance] = None
         self._engine = engine
         self._global_mesh = global_mesh

@@ -160,6 +160,32 @@ class Metrics:
             registry=reg,
         )
 
+    def register_flag_collectors(self, metric_flags: int) -> None:
+        """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
+        (reference flags.go:20-23 + daemon.go:276-287).  "os" → process
+        collector under the ``gubernator`` namespace; "golang" → the
+        host-runtime collectors (Python GC + platform, the analog of Go's
+        GoCollector)."""
+        from gubernator_tpu.config import FLAG_OS_METRICS, FLAG_RUNTIME_METRICS
+
+        if metric_flags & FLAG_OS_METRICS:
+            from prometheus_client import ProcessCollector
+
+            ProcessCollector(namespace="gubernator", registry=self.registry)
+        if metric_flags & FLAG_RUNTIME_METRICS:
+            from prometheus_client import GCCollector, PlatformCollector
+
+            GCCollector(registry=self.registry)
+            PlatformCollector(registry=self.registry)
+
+    def sample(self, name: str, labels: dict | None = None) -> float:
+        """Read one sample value (0.0 when unobserved) — the oracle the
+        reference's distributed tests poll instead of sleeping
+        (functional_test.go:2184-2276 waitForBroadcast/waitForUpdate).
+        Summaries expose ``<name>_count`` / ``<name>_sum``."""
+        v = self.registry.get_sample_value(name, labels or {})
+        return 0.0 if v is None else v
+
     def expose(self) -> bytes:
         """Render the registry in Prometheus text exposition format."""
         return generate_latest(self.registry)

@@ -1,0 +1,93 @@
+"""Metric-flag collectors + metrics-as-oracle helpers.
+
+The reference registers optional OS/runtime collectors behind
+``GUBER_METRIC_FLAGS`` (flags.go:20-57, daemon.go:276-287) and its
+distributed tests poll metric counters instead of sleeping
+(functional_test.go:2184-2276).  Both surfaces are covered here.
+"""
+
+import pytest
+
+from gubernator_tpu.config import (
+    FLAG_OS_METRICS,
+    FLAG_RUNTIME_METRICS,
+    DaemonConfig,
+    parse_metric_flags,
+)
+from gubernator_tpu.utils.metrics import Metrics
+
+
+def test_parse_metric_flags_reference_names():
+    # flags.go:47-52: "os" and "golang" are the two valid names.
+    assert parse_metric_flags(["os"]) == FLAG_OS_METRICS
+    assert parse_metric_flags(["golang"]) == FLAG_RUNTIME_METRICS
+    assert parse_metric_flags(["os", "golang"]) == (
+        FLAG_OS_METRICS | FLAG_RUNTIME_METRICS
+    )
+    # Native aliases for the runtime collector, plus whitespace tolerance.
+    assert parse_metric_flags([" python "]) == FLAG_RUNTIME_METRICS
+    assert parse_metric_flags([]) == 0
+
+
+def test_parse_metric_flags_invalid_ignored(caplog):
+    # flags.go:53-55: unknown names are logged and skipped, not fatal.
+    with caplog.at_level("ERROR", logger="gubernator"):
+        assert parse_metric_flags(["bogus", "os"]) == FLAG_OS_METRICS
+    assert any("invalid flag" in r.message for r in caplog.records)
+
+
+def test_flag_collectors_registered():
+    m = Metrics()
+    m.register_flag_collectors(FLAG_OS_METRICS | FLAG_RUNTIME_METRICS)
+    text = m.expose().decode()
+    # ProcessCollector under the gubernator namespace (daemon.go:278-281)...
+    assert "gubernator_process_cpu_seconds_total" in text
+    # ...and the Python-runtime analog of Go's GoCollector.
+    assert "python_info" in text
+    assert "python_gc_objects_collected_total" in text
+
+
+def test_no_flag_collectors_by_default():
+    text = Metrics().expose().decode()
+    assert "process_cpu_seconds_total" not in text
+    assert "python_info" not in text
+
+
+def test_sample_oracle_reads_counters_and_summaries():
+    m = Metrics()
+    assert m.sample("gubernator_broadcast_duration_count") == 0.0
+    m.broadcast_duration.observe(0.25)
+    m.broadcast_duration.observe(0.75)
+    assert m.sample("gubernator_broadcast_duration_count") == 2.0
+    assert m.sample("gubernator_broadcast_duration_sum") == pytest.approx(1.0)
+    m.getratelimit_counter.labels(calltype="local").inc()
+    assert m.sample(
+        "gubernator_getratelimit_counter_total", {"calltype": "local"}
+    ) == 1.0
+
+
+async def test_daemon_exposes_flag_collectors():
+    """GUBER_METRIC_FLAGS surfaces through the daemon's /metrics page."""
+    import aiohttp
+
+    from gubernator_tpu.config import BehaviorConfig, Config
+    from gubernator_tpu.transport.daemon import spawn_daemon
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="127.0.0.1:0",
+        peer_discovery_type="none",
+        metric_flags=parse_metric_flags(["os", "golang"]),
+    )
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=256)
+    d = await spawn_daemon(conf)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{d.conf.http_listen_address}/metrics"
+            ) as r:
+                text = await r.text()
+        assert "gubernator_process_cpu_seconds_total" in text
+        assert "python_gc_objects_collected_total" in text
+    finally:
+        await d.close()

@@ -153,7 +153,13 @@ async def test_global_behavior_reconciles():
         assert out[0].remaining == 98  # local answer
         assert out[0].metadata.get("owner") == owner.conf.grpc_listen_address
 
-        # Wait for hit forwarding + owner broadcast to land.
+        # Metrics are the oracle, not sleeps (functional_test.go:2184-2276):
+        # the non-owner must flush its hit batch to the owner, and the owner
+        # must complete a broadcast — both observed only after the RPCs land.
+        await c.wait_for_update(c.daemons.index(non_owner))
+        await c.wait_for_broadcast(c.daemons.index(owner))
+        await client.close()
+
         async def owner_saw_hits():
             while True:
                 o = owner.client()
@@ -163,14 +169,15 @@ async def test_global_behavior_reconciles():
                 )
                 await o.close()
                 if resp[0].remaining == 98:
-                    return resp[0]
+                    return
                 await asyncio.sleep(0.02)
 
-        got = await asyncio.wait_for(owner_saw_hits(), timeout=5.0)
-        assert got.remaining == 98
-        await client.close()
+        await asyncio.wait_for(owner_saw_hits(), timeout=5.0)
 
-        # Broadcast must reach the third daemon (neither owner nor hitter).
+        # The broadcast reached the third daemon (neither owner nor hitter).
+        # Still a bounded poll: _broadcast observes its metric even if one
+        # peer push failed (it retries on the next interval), so the metric
+        # alone doesn't prove THIS peer got the state.
         third = [d for d in c.daemons if d is not owner and d is not non_owner][0]
 
         async def third_synced():
