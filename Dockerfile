@@ -1,0 +1,40 @@
+# Build + deploy image for gubernator-tpu (reference: Dockerfile, which
+# builds static Go binaries; here the runtime is Python/JAX so the deploy
+# image is a slim Python base with the package installed).
+#
+# The default install runs the CPU backend of XLA — correct everywhere and
+# right for development clusters. On TPU hosts, build with
+#   --build-arg JAX_EXTRA="jax[tpu]"
+# (pulls libtpu; the daemon finds the chips automatically).
+FROM python:3.12-slim AS build
+
+ARG JAX_EXTRA=""
+
+# g++ builds the native slotmap (the host-side key→slot table).
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY gubernator_tpu ./gubernator_tpu
+
+RUN make -C gubernator_tpu/native \
+    && pip install --no-cache-dir --prefix=/install . ${JAX_EXTRA}
+
+FROM python:3.12-slim
+
+COPY --from=build /install /usr/local
+
+# Container healthcheck probes /v1/HealthCheck on the local daemon
+# (reference Dockerfile HEALTHCHECK, cmd/healthcheck). The probe is a
+# Python process that imports the package (~2s); the timeout must cover
+# that, not just the HTTP round trip.
+HEALTHCHECK --interval=10s --timeout=5s --start-period=60s --retries=2 \
+    CMD [ "gubernator-tpu-healthcheck" ]
+
+ENTRYPOINT ["gubernator-tpu"]
+
+# HTTP / gRPC / memberlist gossip (reference exposes the same three).
+EXPOSE 80
+EXPOSE 81
+EXPOSE 7946

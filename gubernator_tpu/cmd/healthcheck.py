@@ -14,7 +14,12 @@ import urllib.request
 
 
 def main(argv=None) -> int:
-    addr = os.environ.get("GUBER_HTTP_ADDRESS", "localhost:80")
+    # Prefer the no-mTLS status listener when configured: under
+    # GUBER_TLS_CLIENT_AUTH the main gateway rejects cleartext probes,
+    # which is exactly what GUBER_STATUS_HTTP_ADDRESS exists for.
+    addr = os.environ.get("GUBER_STATUS_HTTP_ADDRESS") or os.environ.get(
+        "GUBER_HTTP_ADDRESS", "localhost:80"
+    )
     url = f"http://{addr}/v1/HealthCheck"
     try:
         with urllib.request.urlopen(url, timeout=5) as resp:

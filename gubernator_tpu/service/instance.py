@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -218,6 +219,7 @@ class V1Instance:
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
             )
         self.metrics.concurrent_checks.inc()
+        t0 = time.perf_counter()
         try:
             with tracing.maybe_span(
                 "V1Instance.GetRateLimits", {"batch.size": len(requests)}
@@ -225,6 +227,9 @@ class V1Instance:
                 return await self._get_rate_limits(requests)
         finally:
             self.metrics.concurrent_checks.dec()
+            self.metrics.func_duration.labels(
+                name="V1Instance.GetRateLimits"
+            ).observe(time.perf_counter() - t0)
 
     async def _get_rate_limits(
         self, requests: Sequence[RateLimitRequest]
@@ -323,7 +328,11 @@ class V1Instance:
         handles GLOBAL owner-side queueing + metrics."""
 
         async def run():
+            t0 = time.perf_counter()
             resps = await asyncio.wrap_future(self.tick_loop.submit(reqs))
+            self.metrics.func_duration.labels(
+                name="V1Instance.getLocalRateLimit"
+            ).observe(time.perf_counter() - t0)
             for req, resp in zip(reqs, resps):
                 if has_behavior(req.behavior, Behavior.GLOBAL):
                     self.global_mgr.queue_update(req)
@@ -340,7 +349,13 @@ class V1Instance:
     ) -> List[RateLimitResponse]:
         """Apply requests to the local engine with no routing/queueing — the
         GLOBAL manager's state re-read path (global.go:241-249)."""
-        return await asyncio.wrap_future(self.tick_loop.submit(reqs))
+        t0 = time.perf_counter()
+        try:
+            return await asyncio.wrap_future(self.tick_loop.submit(reqs))
+        finally:
+            self.metrics.func_duration.labels(
+                name="V1Instance.getLocalRateLimit"
+            ).observe(time.perf_counter() - t0)
 
     async def _get_global_rate_limits(
         self, reqs: List[RateLimitRequest]

@@ -37,6 +37,11 @@ class TickLoop:
         self.batch_wait = float(batch_wait)
         self.batch_limit = int(batch_limit)
         self.metrics = metrics
+        # Engine counter mirrors already synced into prometheus families
+        # (the engine counts in plain ints; deltas flow here per tick).
+        self._synced_hits = 0
+        self._synced_misses = 0
+        self._synced_unexpired = 0
         self._cond = threading.Condition()
         self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
@@ -58,6 +63,10 @@ class TickLoop:
                 return fut
             self._pending.append((list(requests), fut))
             self._pending_count += len(requests)
+            if self.metrics is not None:
+                self.metrics.worker_queue_length.labels(
+                    method="GetRateLimits", worker="0"
+                ).set(self._pending_count)
             self._cond.notify()
         return fut
 
@@ -97,8 +106,33 @@ class TickLoop:
                     fut.set_exception(e)
             return
         if self.metrics is not None:
-            self.metrics.tick_duration.observe(time.perf_counter() - t0)
-            self.metrics.tick_batch_size.observe(len(reqs))
+            m = self.metrics
+            m.tick_duration.observe(time.perf_counter() - t0)
+            m.tick_batch_size.observe(len(reqs))
+            m.worker_queue_length.labels(
+                method="GetRateLimits", worker="0"
+            ).set(self._pending_count)
+            m.command_counter.labels(
+                worker="0", method="GetRateLimits"
+            ).inc(len(reqs))
+            # Sync engine counter deltas (hit/miss on slot resolution,
+            # LRU evictions of unexpired buckets) into the catalog families.
+            hits = getattr(self.engine, "metric_hits", 0)
+            misses = getattr(self.engine, "metric_misses", 0)
+            unexp = getattr(self.engine, "metric_unexpired_evictions", 0)
+            if hits > self._synced_hits:
+                m.cache_access_count.labels(type="hit").inc(
+                    hits - self._synced_hits
+                )
+                self._synced_hits = hits
+            if misses > self._synced_misses:
+                m.cache_access_count.labels(type="miss").inc(
+                    misses - self._synced_misses
+                )
+                self._synced_misses = misses
+            if unexp > self._synced_unexpired:
+                m.unexpired_evictions.inc(unexp - self._synced_unexpired)
+                self._synced_unexpired = unexp
         off = 0
         for r, fut in batch:
             if not fut.cancelled():  # waiter may have timed out/cancelled

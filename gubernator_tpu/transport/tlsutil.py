@@ -184,11 +184,14 @@ def generate_cert(
     *,
     client: bool = False,
     common_name: str = "",
+    extra_dns: Tuple[str, ...] = (),
 ) -> Tuple[bytes, bytes]:
     """Server (or client) certificate signed by the auto CA, SANs covering
-    localhost + discovered interface addresses (tls.go:293)."""
+    localhost + discovered interface addresses (tls.go:293) plus any
+    ``extra_dns`` names (compose/k8s service names)."""
     key = _gen_key()
     names, ips = _discover_san_addresses()
+    names = list(names) + [n for n in extra_dns if n not in names]
     cn = common_name or (names[1] if len(names) > 1 else "localhost")
     san: List[x509.GeneralName] = [x509.DNSName(n) for n in names]
     for ip in ips:
@@ -258,3 +261,47 @@ def setup_tls(settings: Optional[TLSSettings]) -> Optional[TLSBundle]:
             if not b.client_auth_ca_pem:
                 b.client_auth_ca_pem = b.ca_pem
     return b
+
+
+def main(argv=None) -> int:
+    """Cert-dir generator for the compose/k8s TLS deployments:
+
+        python -m gubernator_tpu.transport.tlsutil gen <dir> [dns-name ...]
+
+    Writes ``ca.pem``, ``ca.key``, ``gubernator.pem``, ``gubernator.key``
+    — the file names docker-compose-tls.yaml mounts (the reference ships
+    pre-generated equivalents in contrib/certs)."""
+    import argparse
+    import os
+    import sys
+
+    p = argparse.ArgumentParser(description="gubernator-tpu cert generator")
+    p.add_argument("command", choices=["gen"])
+    p.add_argument("dir")
+    p.add_argument("dns", nargs="*",
+                   help="extra SAN dns names (e.g. compose service names)")
+    args = p.parse_args(argv)
+
+    ca_pem, ca_key_pem, ca_cert, ca_key = generate_self_ca()
+    cert_pem, key_pem = generate_cert(
+        ca_cert, ca_key, extra_dns=tuple(args.dns)
+    )
+    os.makedirs(args.dir, exist_ok=True)
+    for fname, data in (
+        ("ca.pem", ca_pem),
+        ("ca.key", ca_key_pem),
+        ("gubernator.pem", cert_pem),
+        ("gubernator.key", key_pem),
+    ):
+        path = os.path.join(args.dir, fname)
+        mode = 0o600 if fname.endswith(".key") else 0o644
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.chmod(path, mode)  # O_CREAT mode is ignored for existing files
+        print(f"wrote {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,6 +66,43 @@ def test_sample_oracle_reads_counters_and_summaries():
     ) == 1.0
 
 
+async def test_service_request_populates_catalog_families():
+    """One live request drives the engine/cache/func families the catalog
+    documents (docs/prometheus.md) — they must not stay at zero."""
+    from gubernator_tpu.config import BehaviorConfig, Config
+    from gubernator_tpu.transport.daemon import DaemonClient, spawn_daemon
+    from gubernator_tpu.types import RateLimitRequest
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="",
+        peer_discovery_type="none",
+    )
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=256)
+    d = await spawn_daemon(conf)
+    try:
+        client = DaemonClient(d.advertise_address)
+        reqs = [RateLimitRequest(name="svc", unique_key="k", hits=1,
+                                 limit=10, duration=60000)]
+        await client.get_rate_limits(reqs)  # miss: installs the bucket
+        await client.get_rate_limits(reqs)  # hit
+        await client.close()
+        m = d.metrics
+        assert m.sample("gubernator_cache_access_count_total",
+                        {"type": "miss"}) >= 1
+        assert m.sample("gubernator_cache_access_count_total",
+                        {"type": "hit"}) >= 1
+        assert m.sample("gubernator_command_counter_total",
+                        {"worker": "0", "method": "GetRateLimits"}) >= 2
+        assert m.sample("gubernator_func_duration_count",
+                        {"name": "V1Instance.GetRateLimits"}) >= 2
+        assert m.sample("gubernator_func_duration_count",
+                        {"name": "V1Instance.getLocalRateLimit"}) >= 2
+        assert m.sample("gubernator_tpu_tick_batch_size_count") >= 2
+    finally:
+        await d.close()
+
+
 async def test_daemon_exposes_flag_collectors():
     """GUBER_METRIC_FLAGS surfaces through the daemon's /metrics page."""
     import aiohttp

@@ -299,3 +299,27 @@ def test_auto_tls_generates_coherent_chain():
     # Keys parse and match certs.
     key = serialization.load_pem_private_key(b.key_pem, None)
     assert key.public_key().public_numbers() == srv.public_key().public_numbers()
+
+
+def test_tlsutil_gen_cli_writes_cert_dir(tmp_path):
+    """The cert generator CLI mints the file set docker-compose-tls.yaml
+    mounts, with the requested extra SAN names."""
+    from gubernator_tpu.transport import tlsutil
+
+    out = tmp_path / "certs"
+    assert tlsutil.main(["gen", str(out), "gubernator-1", "gubernator-2"]) == 0
+    for fname in ("ca.pem", "ca.key", "gubernator.pem", "gubernator.key"):
+        assert (out / fname).exists(), fname
+    ca = x509.load_pem_x509_certificate((out / "ca.pem").read_bytes())
+    srv = x509.load_pem_x509_certificate((out / "gubernator.pem").read_bytes())
+    assert srv.issuer == ca.subject
+    san = srv.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+    names = san.value.get_values_for_type(x509.DNSName)
+    assert "gubernator-1" in names and "gubernator-2" in names
+    assert "localhost" in names
+    # Private keys must not be world-readable.
+    import stat
+
+    for key_file in ("ca.key", "gubernator.key"):
+        mode = stat.S_IMODE((out / key_file).stat().st_mode)
+        assert mode == 0o600, f"{key_file} has mode {oct(mode)}"
