@@ -41,6 +41,8 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
@@ -49,39 +51,226 @@ I32 = jnp.int32
 F64 = jnp.float64
 
 
+# Logical dtype of each BucketState field.  8-byte fields are STORED as
+# multiple 1-D int32 columns and converted to/from the logical dtype at
+# gather/scatter boundaries: on TPU, scatter vectorizes ONLY for 1-D
+# 4-byte element arrays — 8-byte elements and 2-D row scatters fall back
+# to a serialized path (measured 20×/12× slower per element on v5e) — and
+# scatter is the entire cost of a tick.
+#
+# - int64 → (lo, hi) int32 pair (supported ``bitcast_convert_type``).
+# - float64 → an exact three-way Dekker split (hi/mid/lo float32 with
+#   non-overlapping mantissas, 3×24 ≥ 53 bits) bitcast to 3 int32 columns:
+#   this TPU toolchain's X64 rewriter implements no 64-bit bitcasts at
+#   all, so the float must be decomposed arithmetically.  The split is
+#   bit-exact while the residual parts stay in float32 range — i.e. for
+#   values whose lowest mantissa bit is ≥ 2^-149 (≈ |v| ≥ 2^-97, or any
+#   v with ≤ 48 significant bits down there; ~2^-74 where subnormals are
+#   flushed).  A leaky-bucket remaining is a count of whole tokens minus
+#   drips with lowest bits ≥ 2^-52 — nowhere near the floor.
+STATE_DTYPES = {
+    "algorithm": I32,    # Algorithm of the stored item
+    "limit": I64,
+    "remaining": I64,    # token-bucket remaining
+    "remaining_f": F64,  # leaky-bucket remaining (float64 like Go)
+    "duration": I64,     # ms (raw request duration; leaky items store the effective one)
+    "created_at": I64,   # epoch ms (token bucket CreatedAt)
+    "updated_at": I64,   # epoch ms (leaky bucket UpdatedAt)
+    "burst": I64,        # (leaky)
+    "status": I32,       # persisted Status (token bucket only)
+    "expire_at": I64,    # epoch ms (CacheItem.ExpireAt)
+    "in_use": jnp.bool_,  # slot holds a live item
+}
+
+_WIDE = frozenset(k for k, dt in STATE_DTYPES.items() if dt == I64)
+_FLOAT = frozenset(k for k, dt in STATE_DTYPES.items() if dt == F64)
+F32 = jnp.float32
+
+
+def _split_f64(a: jnp.ndarray):
+    """Exact 3-way float32 split of a float64 (non-overlapping parts)."""
+    a = a.astype(F64)
+    hi = a.astype(F32)
+    r1 = a - hi.astype(F64)
+    mid = r1.astype(F32)
+    r2 = r1 - mid.astype(F64)
+    lo = r2.astype(F32)
+    return hi, mid, lo
+
+
+def to_stored(a: jnp.ndarray, field: str):
+    """Logical column → storage columns (tuple of int32 for 8-byte fields)."""
+    if field in _WIDE:
+        b = lax.bitcast_convert_type(a.astype(I64), I32)
+        return (b[..., 0], b[..., 1])
+    if field in _FLOAT:
+        return tuple(
+            lax.bitcast_convert_type(p, I32) for p in _split_f64(a)
+        )
+    return a.astype(STATE_DTYPES[field])
+
+
+def to_logical(a, field: str) -> jnp.ndarray:
+    """Storage columns → logical column (device-side, cheap elementwise)."""
+    if field in _WIDE:
+        lo, hi = a
+        return lax.bitcast_convert_type(jnp.stack([lo, hi], axis=-1), I64)
+    if field in _FLOAT:
+        hi, mid, lo = (
+            lax.bitcast_convert_type(p, F32).astype(F64) for p in a
+        )
+        return hi + mid + lo
+    return a
+
+
+def np_logical(a, field: str) -> np.ndarray:
+    """Host-side storage → logical values (accepts device or np columns)."""
+    if field in _WIDE:
+        lo, hi = (np.asarray(p) for p in a)
+        return (hi.astype(np.int64) << 32) | lo.view(np.uint32).astype(np.int64)
+    if field in _FLOAT:
+        hi, mid, lo = (
+            np.asarray(p).view(np.float32).astype(np.float64) for p in a
+        )
+        return hi + mid + lo
+    return np.asarray(a)
+
+
+def _map_field(a, fn):
+    if isinstance(a, tuple):
+        return tuple(fn(p) for p in a)
+    return fn(a)
+
+
+def slice_field(a, sl):
+    """Slice one stored field (array or tuple of part columns)."""
+    return _map_field(a, lambda p: p[sl])
+
+
 class BucketState(NamedTuple):
-    """SoA bucket state; each field is an array over table slots (or a gather
-    of them).  Unifies the reference's ``TokenBucketItem`` (store.go:37-43),
+    """SoA bucket state; each field is a column (or tuple of storage
+    columns) over table slots.
+
+    Unifies the reference's ``TokenBucketItem`` (store.go:37-43),
     ``LeakyBucketItem`` (store.go:29-35) and ``CacheItem`` (cache.go:29-41).
+
+    Two representations share this type (mirroring how the kernels use it):
+
+    - **stored**: the table; 8-byte fields as tuples of 1-D int32 columns
+      (see :data:`STATE_DTYPES`) so scatters take TPU's fast path.
+    - **logical**: per-request gathers / full-table views with the logical
+      dtypes, as produced by :func:`gather_state` / :func:`logical_view` —
+      what :func:`bucket_transition` computes on.
     """
 
-    algorithm: jnp.ndarray  # i32: Algorithm of the stored item
-    limit: jnp.ndarray      # i64
-    remaining: jnp.ndarray  # i64: token-bucket remaining
-    remaining_f: jnp.ndarray  # f64: leaky-bucket remaining (float64 like Go)
-    duration: jnp.ndarray   # i64 ms (raw request duration; leaky new items store the effective one)
-    created_at: jnp.ndarray  # i64 epoch ms (token bucket CreatedAt)
-    updated_at: jnp.ndarray  # i64 epoch ms (leaky bucket UpdatedAt)
-    burst: jnp.ndarray      # i64 (leaky)
-    status: jnp.ndarray     # i32: persisted Status (token bucket only)
-    expire_at: jnp.ndarray  # i64 epoch ms (CacheItem.ExpireAt)
-    in_use: jnp.ndarray     # bool: slot holds a live item
+    algorithm: jnp.ndarray
+    limit: jnp.ndarray
+    remaining: jnp.ndarray
+    remaining_f: jnp.ndarray
+    duration: jnp.ndarray
+    created_at: jnp.ndarray
+    updated_at: jnp.ndarray
+    burst: jnp.ndarray
+    status: jnp.ndarray
+    expire_at: jnp.ndarray
+    in_use: jnp.ndarray
 
     @classmethod
     def zeros(cls, n: int) -> "BucketState":
-        return cls(
-            algorithm=jnp.zeros(n, I32),
-            limit=jnp.zeros(n, I64),
-            remaining=jnp.zeros(n, I64),
-            remaining_f=jnp.zeros(n, F64),
-            duration=jnp.zeros(n, I64),
-            created_at=jnp.zeros(n, I64),
-            updated_at=jnp.zeros(n, I64),
-            burst=jnp.zeros(n, I64),
-            status=jnp.zeros(n, I32),
-            expire_at=jnp.zeros(n, I64),
-            in_use=jnp.zeros(n, jnp.bool_),
+        """Stored-layout all-zeros table."""
+        def z(f):
+            if f in _WIDE:
+                return (jnp.zeros(n, I32), jnp.zeros(n, I32))
+            if f in _FLOAT:
+                # Three DISTINCT buffers: donation rejects aliased args.
+                return tuple(jnp.zeros(n, I32) for _ in range(3))
+            return jnp.zeros(n, STATE_DTYPES[f])
+
+        return cls(**{f: z(f) for f in STATE_DTYPES})
+
+    @property
+    def capacity(self) -> int:
+        return self.algorithm.shape[0]
+
+
+def logical_view(state: BucketState) -> BucketState:
+    """Full-table logical columns (elementwise bitcast; no data movement)."""
+    return BucketState(**{
+        f: to_logical(getattr(state, f), f) for f in STATE_DTYPES
+    })
+
+
+def stored_view(state: BucketState) -> BucketState:
+    """Logical full-table columns → storage layout (inverse of
+    :func:`logical_view`)."""
+    return BucketState(**{
+        f: to_stored(getattr(state, f), f) for f in STATE_DTYPES
+    })
+
+
+def gather_field(state: BucketState, field: str, idx: jnp.ndarray,
+                 fill: bool = False) -> jnp.ndarray:
+    """Gather one logical column at ``idx`` from a stored-layout table."""
+    def g(a):
+        if fill:
+            return a.at[idx].get(mode="fill", fill_value=0)
+        return a[idx]
+
+    return to_logical(_map_field(getattr(state, field), g), field)
+
+
+def gather_state(state: BucketState, idx: jnp.ndarray,
+                 fill: bool = False) -> BucketState:
+    """Gather logical rows at ``idx`` from a stored-layout table.
+
+    ``fill=True`` reads zeros for out-of-range indices (readback paths);
+    the default promises in-bounds indices (tick hot path).
+    """
+    return BucketState(**{
+        f: gather_field(state, f, idx, fill=fill) for f in STATE_DTYPES
+    })
+
+
+def _put_field(stored, field: str, idx, values, **at_kwargs):
+    """Scatter one logical column into one stored field's column(s)."""
+    vals = to_stored(values, field)
+    if isinstance(stored, tuple):
+        return tuple(
+            s.at[idx].set(v, **at_kwargs) for s, v in zip(stored, vals)
         )
+    return stored.at[idx].set(vals, **at_kwargs)
+
+
+def scatter_state(state: BucketState, idx: jnp.ndarray,
+                  rows: BucketState) -> BucketState:
+    """Scatter logical rows back into a stored-layout table; out-of-range
+    indices drop (the rank-round masking convention)."""
+    return BucketState(**{
+        f: _put_field(getattr(state, f), f, idx, getattr(rows, f), mode="drop")
+        for f in STATE_DTYPES
+    })
+
+
+def scatter_field(state: BucketState, field: str, idx: jnp.ndarray,
+                  values: jnp.ndarray) -> BucketState:
+    """Scatter one logical column into the stored table (drop mode)."""
+    return state._replace(**{
+        field: _put_field(getattr(state, field), field, idx, values, mode="drop")
+    })
+
+
+def set_slot(state: BucketState, slot: int, **fields) -> BucketState:
+    """Write logical field values into one slot of a stored-layout table
+    (test/debug convenience)."""
+    return state._replace(**{
+        name: _put_field(getattr(state, name), name, slot, jnp.asarray(val))
+        for name, val in fields.items()
+    })
+
+
+def get_slot(state: BucketState, field: str, slot: int):
+    """Read one logical field value from a stored-layout table (host)."""
+    return np_logical(getattr(state, field), field)[slot]
 
 
 class ReqBatch(NamedTuple):

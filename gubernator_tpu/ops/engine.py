@@ -39,6 +39,11 @@ from gubernator_tpu.ops.buckets import (
     ReqBatch,
     RespBatch,
     bucket_transition,
+    gather_field,
+    gather_state,
+    np_logical,
+    scatter_field,
+    scatter_state,
 )
 from gubernator_tpu.types import (
     Algorithm,
@@ -266,13 +271,13 @@ def _apply_merged_followers(
     )
     group_ok = bad_per_seg[seg_id] == 0
 
-    # Post-head state of the group's slot.
+    # Post-head state of the group's slot (logical views of stored layout).
     slot = reqs.slot
-    R0 = state.remaining[slot]
-    F0 = state.remaining_f[slot]
+    R0 = gather_field(state, "remaining", slot)
+    F0 = gather_field(state, "remaining_f", slot)
     N0 = F0.astype(jnp.int64)  # Go float64→int64 truncation
     S0 = state.status[slot]
-    E = state.expire_at[slot]
+    E = gather_field(state, "expire_at", slot)
     alive = now <= E
 
     merged = group_ok & ok & alive & (rank > 0)
@@ -325,11 +330,9 @@ def _apply_merged_followers(
         F0 - (jnp.minimum(i, q) * h).astype(jnp.float64),
     )
     scat_leaky = jnp.where(is_last & ~is_tok, slot, capacity)
-    state = state._replace(
-        remaining=state.remaining.at[scat_tok].set(rem_resp, mode="drop"),
-        status=state.status.at[scat_tok].set(status_final, mode="drop"),
-        remaining_f=state.remaining_f.at[scat_leaky].set(remf_final, mode="drop"),
-    )
+    state = scatter_field(state, "remaining", scat_tok, rem_resp)
+    state = scatter_field(state, "status", scat_tok, status_final)
+    state = scatter_field(state, "remaining_f", scat_leaky, remf_final)
     return state, resp, merged
 
 
@@ -369,14 +372,12 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
         )
 
         def round_step(st, resp, active):
-            gathered = jax.tree.map(lambda a: a[reqs.slot], st)
+            gathered = gather_state(st, reqs.slot)
             new_g, r_out = bucket_transition(now, gathered, reqs)
             # Scatter only this round's rows; inactive rows aim out of
             # bounds and are dropped.
             scat = jnp.where(active, reqs.slot, capacity)
-            st = jax.tree.map(
-                lambda tbl, upd: tbl.at[scat].set(upd, mode="drop"), st, new_g
-            )
+            st = scatter_state(st, scat, new_g)
             resp = jax.tree.map(
                 lambda old, new: jnp.where(active, new, old), resp, r_out
             )
@@ -437,27 +438,24 @@ def make_install_fn():
         # Invalid rows aim one past the table and drop.  The sentinel must
         # stay < 2^31: GSPMD partitions the scatter with int32 index math,
         # and a 2^40 sentinel truncates to slot 0 on a sharded table.
-        scat = jnp.where(valid != 0, slot, jnp.int64(state.limit.shape[0]))
+        scat = jnp.where(valid != 0, slot, jnp.int64(state.capacity))
 
-        def put(tbl, upd):
-            return tbl.at[scat].set(upd, mode="drop")
-
-        return BucketState(
-            algorithm=put(state.algorithm, algo.astype(jnp.int32)),
-            limit=put(state.limit, limit),
-            remaining=put(state.remaining, jnp.where(is_token, remaining, jnp.int64(0))),
-            remaining_f=put(
-                state.remaining_f,
-                jnp.where(is_token, jnp.float64(0.0), remaining.astype(jnp.float64)),
+        rows = BucketState(
+            algorithm=algo.astype(jnp.int32),
+            limit=limit,
+            remaining=jnp.where(is_token, remaining, jnp.int64(0)),
+            remaining_f=jnp.where(
+                is_token, jnp.float64(0.0), remaining.astype(jnp.float64)
             ),
-            duration=put(state.duration, duration),
-            created_at=put(state.created_at, jnp.where(is_token, now, jnp.int64(0))),
-            updated_at=put(state.updated_at, jnp.where(is_token, jnp.int64(0), now)),
-            burst=put(state.burst, jnp.where(is_token, jnp.int64(0), limit)),
-            status=put(state.status, status.astype(jnp.int32)),
-            expire_at=put(state.expire_at, reset_time),
-            in_use=put(state.in_use, valid != 0),
+            duration=duration,
+            created_at=jnp.where(is_token, now, jnp.int64(0)),
+            updated_at=jnp.where(is_token, jnp.int64(0), now),
+            burst=jnp.where(is_token, jnp.int64(0), limit),
+            status=status.astype(jnp.int32),
+            expire_at=reset_time,
+            in_use=valid != 0,
         )
+        return scatter_state(state, scat, rows)
 
     return install
 
@@ -478,26 +476,22 @@ def make_restore_fn():
     def restore(state: BucketState, ints: jnp.ndarray, floats: jnp.ndarray) -> BucketState:
         f = dict(zip(ITEM_INT_ROWS, ints))
         # Sentinel must stay < 2^31 (see make_install_fn).
-        scat = jnp.where(
-            f["valid"] != 0, f["slot"], jnp.int64(state.limit.shape[0])
-        )
+        scat = jnp.where(f["valid"] != 0, f["slot"], jnp.int64(state.capacity))
 
-        def put(tbl, upd):
-            return tbl.at[scat].set(upd, mode="drop")
-
-        return BucketState(
-            algorithm=put(state.algorithm, f["algorithm"].astype(jnp.int32)),
-            limit=put(state.limit, f["limit"]),
-            remaining=put(state.remaining, f["remaining"]),
-            remaining_f=put(state.remaining_f, floats),
-            duration=put(state.duration, f["duration"]),
-            created_at=put(state.created_at, f["created_at"]),
-            updated_at=put(state.updated_at, f["updated_at"]),
-            burst=put(state.burst, f["burst"]),
-            status=put(state.status, f["status"].astype(jnp.int32)),
-            expire_at=put(state.expire_at, f["expire_at"]),
-            in_use=put(state.in_use, f["valid"] != 0),
+        rows = BucketState(
+            algorithm=f["algorithm"].astype(jnp.int32),
+            limit=f["limit"],
+            remaining=f["remaining"],
+            remaining_f=floats,
+            duration=f["duration"],
+            created_at=f["created_at"],
+            updated_at=f["updated_at"],
+            burst=f["burst"],
+            status=f["status"].astype(jnp.int32),
+            expire_at=f["expire_at"],
+            in_use=f["valid"] != 0,
         )
+        return scatter_state(state, scat, rows)
 
     return restore
 
@@ -508,24 +502,22 @@ def make_readback_fn():
     Returns ((10, B) int64, (B,) float64); out-of-range slots read zeros."""
 
     def readback(state: BucketState, slots: jnp.ndarray):
-        def g(tbl):
-            return tbl.at[slots].get(mode="fill", fill_value=0)
-
+        rows = gather_state(state, slots, fill=True)
         ints = jnp.stack(
             [
-                g(state.algorithm).astype(jnp.int64),
-                g(state.limit),
-                g(state.remaining),
-                g(state.duration),
-                g(state.created_at),
-                g(state.updated_at),
-                g(state.burst),
-                g(state.status).astype(jnp.int64),
-                g(state.expire_at),
-                g(state.in_use).astype(jnp.int64),
+                rows.algorithm.astype(jnp.int64),
+                rows.limit,
+                rows.remaining,
+                rows.duration,
+                rows.created_at,
+                rows.updated_at,
+                rows.burst,
+                rows.status.astype(jnp.int64),
+                rows.expire_at,
+                rows.in_use.astype(jnp.int64),
             ]
         )
-        return ints, g(state.remaining_f)
+        return ints, rows.remaining_f
 
     return readback
 
@@ -542,17 +534,14 @@ def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
     Shared by both engines' ``export_items``: one vectorized slice per
     column, then the (unavoidable, dict-shaped) per-item build.
     """
+    from gubernator_tpu.ops.buckets import slice_field
+
     cols = {
-        "algorithm": st.algorithm[live],
-        "limit": st.limit[live],
-        "remaining": st.remaining[live],
-        "remaining_f": st.remaining_f[live],
-        "duration": st.duration[live],
-        "created_at": st.created_at[live],
-        "updated_at": st.updated_at[live],
-        "burst": st.burst[live],
-        "status": st.status[live],
-        "expire_at": st.expire_at[live],
+        name: np_logical(slice_field(getattr(st, name), live), name)
+        for name in (
+            "algorithm", "limit", "remaining", "remaining_f", "duration",
+            "created_at", "updated_at", "burst", "status", "expire_at",
+        )
     }
     return [
         {
@@ -849,7 +838,7 @@ class TickEngine:
         freed, victims = select_reclaim_victims(
             mapped,
             np.asarray(self.state.in_use),
-            np.asarray(self.state.expire_at),
+            np_logical(self.state.expire_at, "expire_at"),
             self._last_access,
             self._tick_count,
             now,

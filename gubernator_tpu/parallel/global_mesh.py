@@ -59,7 +59,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.buckets import BucketState, ReqBatch, bucket_transition
+from gubernator_tpu.ops.buckets import (
+    BucketState,
+    ReqBatch,
+    bucket_transition,
+    gather_state,
+    logical_view,
+    np_logical,
+    scatter_state,
+    slice_field,
+    stored_view,
+)
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
@@ -147,12 +157,10 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
         def body(carry):
             k, st, aux, resp = carry
             active = r.valid & (rank == k)
-            gathered = jax.tree.map(lambda a: a[r.slot], st)
+            gathered = gather_state(st, r.slot)
             new_g, r_out = bucket_transition(now, gathered, r)
             scat = jnp.where(active, r.slot, capacity)
-            st = jax.tree.map(
-                lambda tbl, upd: tbl.at[scat].set(upd, mode="drop"), st, new_g
-            )
+            st = scatter_state(st, scat, new_g)
             aux = aux.at[:, scat].set(aux_vals, mode="drop")
             new_resp = (r_out.status, r_out.limit, r_out.remaining,
                         r_out.reset_time, r_out.over_limit)
@@ -242,7 +250,10 @@ def make_global_reconcile_fn(
                 ) > 0
             return lax.psum(jnp.where(owned, a, jnp.zeros((), a.dtype)), "node")
 
-        base = jax.tree.map(bcast, rep)
+        # Stored-layout broadcast (the masked psum is exact on bitcast i32
+        # halves: exactly one node contributes per slot), then a logical
+        # view for the dense transition below.
+        base = logical_view(jax.tree.map(bcast, rep))
 
         def gather_rows(x):
             """all_gather x over 'node' via one-hot psum → (n_nodes, *x.shape)."""
@@ -324,7 +335,7 @@ def make_global_reconcile_fn(
                 base, acc[ACC_HITS], acc[ACC_RESET], acc[ACC_COUNT] > 0
             )
         return (
-            jax.tree.map(lambda a: a[None], merged),
+            jax.tree.map(lambda a: a[None], stored_view(merged)),
             jnp.zeros_like(accum_blk),
         )
 
@@ -556,7 +567,7 @@ class MeshGlobalEngine:
         freed, victims = select_reclaim_victims(
             mapped,
             np.asarray(self.state.in_use[0]),
-            np.asarray(self.state.expire_at[0]),
+            np_logical(slice_field(self.state.expire_at, 0), "expire_at"),
             self._last_access,
             self._tick_count,
             now,
@@ -603,14 +614,20 @@ class MeshGlobalEngine:
         slot = self.slots.get(key)
         if slot is None:
             return None
-        st = jax.tree.map(lambda a: np.asarray(a[:, slot]), self.state)
+        st = {
+            name: np_logical(
+                slice_field(getattr(self.state, name), (slice(None), slot)),
+                name,
+            )
+            for name in ("remaining", "remaining_f", "status", "in_use", "limit")
+        }
         return [
             {
-                "remaining": int(st.remaining[d]),
-                "remaining_f": float(st.remaining_f[d]),
-                "status": int(st.status[d]),
-                "in_use": bool(st.in_use[d]),
-                "limit": int(st.limit[d]),
+                "remaining": int(st["remaining"][d]),
+                "remaining_f": float(st["remaining_f"][d]),
+                "status": int(st["status"][d]),
+                "in_use": bool(st["in_use"][d]),
+                "limit": int(st["limit"][d]),
             }
             for d in range(self.n_nodes)
         ]

@@ -34,7 +34,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.buckets import BucketState
+from gubernator_tpu.ops.buckets import BucketState, np_logical, slice_field
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
@@ -188,7 +188,9 @@ class MeshTickEngine:
         freed, victims = select_reclaim_victims(
             mapped,
             np.asarray(self.state.in_use[lo : lo + self.local_capacity]),
-            np.asarray(self.state.expire_at[lo : lo + self.local_capacity]),
+            np_logical(slice_field(
+                self.state.expire_at, slice(lo, lo + self.local_capacity)
+            ), "expire_at"),
             self._last_access[lo : lo + self.local_capacity],
             self._tick_count,
             now,

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gubernator_tpu.ops.buckets import BucketState
+from gubernator_tpu.ops.buckets import BucketState, get_slot, set_slot
 from gubernator_tpu.ops.engine import REQ_ROW_INDEX, REQ_ROWS, make_tick_fn
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
@@ -85,9 +85,9 @@ def test_herd_fresh_key_drains_then_over():
     assert list(remaining[:10]) == list(range(9, -1, -1))
     assert (status[:10] == Status.UNDER_LIMIT).all()
     assert (status[10:64] == Status.OVER_LIMIT).all()
-    assert int(np.asarray(f[0].remaining)[3]) == 0
+    assert int(get_slot(f[0], "remaining", 3)) == 0
     # At-zero branch persisted OVER into the stored item (algorithms.go:162-169).
-    assert int(np.asarray(f[0].status)[3]) == Status.OVER_LIMIT
+    assert int(get_slot(f[0], "status", 3)) == Status.OVER_LIMIT
 
 
 def test_herd_nondivisible_no_drain_keeps_remainder():
@@ -100,8 +100,8 @@ def test_herd_nondivisible_no_drain_keeps_remainder():
     assert list(r[2][:3]) == [7, 4, 1]
     assert (r[0][3:32] == Status.OVER_LIMIT).all()
     assert (r[2][3:32] == 1).all()
-    assert int(np.asarray(f[0].remaining)[3]) == 1
-    assert int(np.asarray(f[0].status)[3]) == Status.UNDER_LIMIT
+    assert int(get_slot(f[0], "remaining", 3)) == 1
+    assert int(get_slot(f[0], "status", 3)) == Status.UNDER_LIMIT
 
 
 def test_herd_nondivisible_drain_zeroes():
@@ -112,25 +112,18 @@ def test_herd_nondivisible_drain_zeroes():
     r = f[1]
     assert list(r[2][:3]) == [7, 4, 1]
     assert (r[2][3:32] == 0).all()
-    assert int(np.asarray(f[0].remaining)[3]) == 0
+    assert int(get_slot(f[0], "remaining", 3)) == 0
     # Drain → at-zero from rank q+2 on → OVER persisted.
-    assert int(np.asarray(f[0].status)[3]) == Status.OVER_LIMIT
+    assert int(get_slot(f[0], "status", 3)) == Status.OVER_LIMIT
 
 
 def test_herd_on_existing_bucket_with_persisted_over():
     # Stored status OVER with remaining bumped back up (limit-delta path):
     # follower responses must echo the *persisted* status while under.
     st = BucketState.zeros(CAP)
-    st = st._replace(
-        algorithm=st.algorithm.at[3].set(0),
-        limit=st.limit.at[3].set(10),
-        remaining=st.remaining.at[3].set(5),
-        duration=st.duration.at[3].set(60_000),
-        created_at=st.created_at.at[3].set(500),
-        status=st.status.at[3].set(Status.OVER_LIMIT),
-        expire_at=st.expire_at.at[3].set(60_500),
-        in_use=st.in_use.at[3].set(True),
-    )
+    st = set_slot(st, 3, algorithm=0, limit=10, remaining=5,
+                  duration=60_000, created_at=500, status=int(Status.OVER_LIMIT),
+                  expire_at=60_500, in_use=True)
     m = packed(uniform_rows(8, hits=1, limit=10, known_head=1))
     f, s = run_both(m, state=st)
     assert_identical(f, s)
@@ -166,7 +159,7 @@ def test_leaky_herd_fresh_key_drains_then_over():
     assert list(r[2][:10]) == list(range(9, -1, -1))
     assert (r[0][:10] == Status.UNDER_LIMIT).all()
     assert (r[0][10:64] == Status.OVER_LIMIT).all()
-    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+    assert float(get_slot(f[0], "remaining_f", 3)) == 0.0
 
 
 def test_leaky_herd_preserves_fraction_through_decrements():
@@ -174,43 +167,29 @@ def test_leaky_herd_preserves_fraction_through_decrements():
     # decrements bit-exactly — the closed form subtracts from the float,
     # not the truncation.
     st = BucketState.zeros(CAP)
-    st = st._replace(
-        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
-        limit=st.limit.at[3].set(10),
-        remaining_f=st.remaining_f.at[3].set(7.625),
-        duration=st.duration.at[3].set(60_000),
-        burst=st.burst.at[3].set(10),
-        updated_at=st.updated_at.at[3].set(1_000),
-        expire_at=st.expire_at.at[3].set(61_000),
-        in_use=st.in_use.at[3].set(True),
-    )
+    st = set_slot(st, 3, algorithm=int(Algorithm.LEAKY_BUCKET), limit=10,
+                  remaining_f=7.625, duration=60_000, burst=10,
+                  updated_at=1_000, expire_at=61_000, in_use=True)
     m = packed(uniform_rows(4, hits=2, limit=10, known_head=1,
                             algorithm=Algorithm.LEAKY_BUCKET))
     f, s = run_both(m, state=st)
     assert_identical(f, s)
     # 7.625 → head 5.625 → followers 3.625, 1.625, then over-ask parks it.
-    assert float(np.asarray(f[0].remaining_f)[3]) == 1.625
+    assert float(get_slot(f[0], "remaining_f", 3)) == 1.625
 
 
 def test_leaky_herd_exact_remainder_zeroes_float():
     # algorithms.go:392-397: the exact-remainder branch sets the *float*
     # remaining to exactly 0.0, dropping any fraction.
     st = BucketState.zeros(CAP)
-    st = st._replace(
-        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
-        limit=st.limit.at[3].set(10),
-        remaining_f=st.remaining_f.at[3].set(6.5),
-        duration=st.duration.at[3].set(60_000),
-        burst=st.burst.at[3].set(10),
-        updated_at=st.updated_at.at[3].set(1_000),
-        expire_at=st.expire_at.at[3].set(61_000),
-        in_use=st.in_use.at[3].set(True),
-    )
+    st = set_slot(st, 3, algorithm=int(Algorithm.LEAKY_BUCKET), limit=10,
+                  remaining_f=6.5, duration=60_000, burst=10,
+                  updated_at=1_000, expire_at=61_000, in_use=True)
     m = packed(uniform_rows(8, hits=2, limit=10, known_head=1,
                             algorithm=Algorithm.LEAKY_BUCKET))
     f, s = run_both(m, state=st)
     assert_identical(f, s)
-    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+    assert float(get_slot(f[0], "remaining_f", 3)) == 0.0
 
 
 def test_leaky_herd_drain_zeroes_and_at_zero_reset_time():
@@ -222,28 +201,21 @@ def test_leaky_herd_drain_zeroes_and_at_zero_reset_time():
                             behavior=Behavior.DRAIN_OVER_LIMIT))
     f, s = run_both(m)
     assert_identical(f, s)
-    assert float(np.asarray(f[0].remaining_f)[3]) == 0.0
+    assert float(get_slot(f[0], "remaining_f", 3)) == 0.0
 
 
 def test_leaky_herd_zero_remaining_keeps_fraction():
     # trunc(remaining)=0 with a live fraction: every follower is at-zero
     # and the fraction must survive (no exact/drain step ever fires).
     st = BucketState.zeros(CAP)
-    st = st._replace(
-        algorithm=st.algorithm.at[3].set(Algorithm.LEAKY_BUCKET),
-        limit=st.limit.at[3].set(10),
-        remaining_f=st.remaining_f.at[3].set(0.875),
-        duration=st.duration.at[3].set(60_000),
-        burst=st.burst.at[3].set(10),
-        updated_at=st.updated_at.at[3].set(1_000),
-        expire_at=st.expire_at.at[3].set(61_000),
-        in_use=st.in_use.at[3].set(True),
-    )
+    st = set_slot(st, 3, algorithm=int(Algorithm.LEAKY_BUCKET), limit=10,
+                  remaining_f=0.875, duration=60_000, burst=10,
+                  updated_at=1_000, expire_at=61_000, in_use=True)
     m = packed(uniform_rows(6, hits=2, limit=10, known_head=1,
                             algorithm=Algorithm.LEAKY_BUCKET))
     f, s = run_both(m, state=st)
     assert_identical(f, s)
-    assert float(np.asarray(f[0].remaining_f)[3]) == 0.875
+    assert float(get_slot(f[0], "remaining_f", 3)) == 0.875
 
 
 def test_leaky_herd_4096_one_key():
