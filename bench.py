@@ -60,6 +60,8 @@ def _pcts(samples_ms):
 # Rung 1: device kernel ceiling
 # ----------------------------------------------------------------------
 def rung_kernel():
+    from jax import lax
+
     from gubernator_tpu.ops.buckets import BucketState
     from gubernator_tpu.ops.engine import REQ_ROWS, REQ_ROW_INDEX as rows, make_tick_fn
 
@@ -78,41 +80,67 @@ def rung_kernel():
     m[rows["created_at"]] = now
     m[rows["valid"]] = 1
 
-    from jax import lax
-
     tick = make_tick_fn(capacity)
-    iters = 100
-
-    # Chain `iters` ticks inside ONE compiled program: measures the chip,
-    # not the dispatch path — the tunneled device's per-call latency (and
-    # its intermittent refusal to pipeline async dispatches) can't touch an
-    # on-device fori_loop.
-    @jax.jit
-    def run_chain(state, packed):
-        def body(i, carry):
-            st, _ = carry
-            return tick(st, packed, jnp.int64(now) + i)
-
-        return lax.fori_loop(
-            0, iters, body, (state, jnp.zeros((5, batch), jnp.int64))
-        )
-
     state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
     packed = jnp.asarray(m)
-    st, resp = run_chain(state, packed)  # compile + warm
-    jax.block_until_ready(resp)
 
-    best = 0.0
-    for trial in range(3):
-        t0 = time.perf_counter()
-        st, resp = run_chain(st, packed)
-        jax.block_until_ready(resp)
-        dt = time.perf_counter() - t0
-        best = max(best, batch * iters / dt)
+    # Honest timing on a tunneled device requires BOTH: (a) chaining ticks
+    # inside one compiled fori_loop so per-dispatch latency can't dominate,
+    # and (b) timing to a host-side D2H materialization — on this platform
+    # ``block_until_ready`` returns before execution completes, so any
+    # number not closed by an np.asarray measures dispatch, not the chip.
+    # The constant dispatch+roundtrip cost cancels differentially:
+    # per-tick = (t(2N) - t(N)) / N.
+    def chain(iters):
+        @jax.jit
+        def run(st):
+            # Carry the response matrix too: dropping it would let XLA
+            # dead-code-eliminate the whole response side of the tick and
+            # measure less work than a production tick performs.
+            def body(i, carry):
+                s, _ = carry
+                return tick(s, packed, jnp.int64(now) + i)
+
+            return lax.fori_loop(
+                0, iters, body, (st, jnp.zeros((5, batch), jnp.int64))
+            )
+
+        return run
+
+    n = 20 if FAST else 100
+    runs = {k: chain(k) for k in (n, 2 * n)}
+
+    def timed(r):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s, resp = r(state)
+            np.asarray(resp[:1, :1])  # force completion
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for r in runs.values():  # compile + warm
+        np.asarray(r(state)[1][:1, :1])
+    per_tick = (timed(runs[2 * n]) - timed(runs[n])) / n
+    if per_tick <= 0:
+        # Tunnel jitter swamped the differential: a spike in the short
+        # chain's best makes the long chain look free.  Report the failed
+        # measurement as such, never a fictional rate.
+        return {
+            "rung": "kernel_1m",
+            "decisions_per_sec": 0,
+            "tick_ms": None,
+            "batch": batch,
+            "unreliable": True,
+            "vs_target_50m": 0,
+        }
+    rate = batch / per_tick
     return {
         "rung": "kernel_1m",
-        "decisions_per_sec": round(best, 1),
-        "vs_target_50m": round(best / TARGET_DECISIONS, 4),
+        "decisions_per_sec": round(rate, 1),
+        "tick_ms": round(per_tick * 1000, 4),
+        "batch": batch,
+        "vs_target_50m": round(rate / TARGET_DECISIONS, 4),
     }
 
 
@@ -486,6 +514,14 @@ def main():
                 "unit": "decisions/s",
                 "vs_baseline": kern.get("vs_target_50m", 0),
                 "p99_ms_at_10m_keys": big_p99,
+                # Engine latencies ride one device dispatch+D2H per tick;
+                # over a tunneled device that roundtrip (rt_ms, ≈0.1ms on
+                # local hardware) dominates p99 — the net figure estimates
+                # the local-deployment latency.
+                "p99_net_of_roundtrip_ms": (
+                    round(max(0.0, big_p99 - rt_ms), 3)
+                    if isinstance(big_p99, (int, float)) else None
+                ),
                 "p99_target_ms": TARGET_P99_MS,
                 "device_roundtrip_ms": rt_ms,
                 "ladder": ladder,

@@ -69,7 +69,9 @@ def _slot_segments(slot: jnp.ndarray, valid: jnp.ndarray, capacity: int):
     target for segmented reductions.
     """
     b = slot.shape[0]
-    sort_key = jnp.where(valid, slot, capacity).astype(jnp.int64)
+    # int32 sort key: capacity < 2^31 always (slots are i32); a 64-bit
+    # key doubles the on-device sort cost for nothing.
+    sort_key = jnp.where(valid, slot, capacity).astype(jnp.int32)
     order = jnp.argsort(sort_key, stable=True)
     sorted_key = sort_key[order]
     idx = jnp.arange(b, dtype=jnp.int32)
@@ -185,11 +187,10 @@ def pack_resp(resp: RespBatch) -> jnp.ndarray:
 
 
 def _apply_merged_followers(
-    state: BucketState,
+    new_g: BucketState,
     resp: RespBatch,
     reqs: ReqBatch,
     now: jnp.ndarray,
-    capacity: int,
     rank: jnp.ndarray,
     group_size: jnp.ndarray,
     head_idx: jnp.ndarray,
@@ -197,13 +198,17 @@ def _apply_merged_followers(
 ):
     """Closed-form application of duplicate-key followers (token + leaky).
 
-    Called after round 0 (all group heads applied).  For a slot group whose
-    members are *identical* requests (hits>0, no RESET_REMAINING/Gregorian),
-    the sequential fold the rank rounds would perform has a closed form in
-    the member's rank ``i`` against the post-head state.  Let ``base`` be
-    the post-head integer remaining — ``remaining`` for token buckets,
-    ``trunc(remaining_f)`` for leaky (algorithms.go:383-387 works on the
-    truncated value) — and ``q = base // h``:
+    Runs against ``new_g`` — the per-request rows of the heads' round-0
+    transition output (``new_g[head_idx]`` is each request's post-head slot
+    state), so the whole merge needs no table gather and no second scatter:
+    the head's scatter row carries the group-final values.  For a slot
+    group whose members are *identical* requests (hits>0, no
+    RESET_REMAINING/Gregorian), the sequential fold the rank rounds would
+    perform has a closed form in the member's rank ``i`` against the
+    post-head state.  Let ``base`` be the post-head integer remaining —
+    ``remaining`` for token buckets, ``trunc(remaining_f)`` for leaky
+    (algorithms.go:383-387 works on the truncated value) — and
+    ``q = base // h``:
 
         i <= q  → UNDER, remaining base - i·h
                   (token echoes stored status S0, leaky reports UNDER)
@@ -224,13 +229,17 @@ def _apply_merged_followers(
     ``q+2`` under DRAIN_OVER_LIMIT, never otherwise; leaky has no persisted
     status.  Leaky ``remaining_f`` keeps its fractional part through
     integer decrements but is *exactly zeroed* by an exact-remainder step
-    (:392-397) or a drain step (:414-417).  Only the *last* follower
-    scatters state; expire/created/duration are untouched (token hits never
-    renew; leaky followers re-bump the same expiration the head wrote; a
-    uniform group can't change limit or duration after its head).
+    (:392-397) or a drain step (:414-417).  The group-final state is
+    evaluated at the last member's rank (``group_size - 1``) and written
+    into the HEAD's scatter row; expire/created/duration are untouched
+    (token hits never renew; leaky followers re-bump the same expiration
+    the head wrote; a uniform group can't change limit or duration after
+    its head).
 
-    Returns ``(state, resp, merged)`` where ``merged`` marks follower rows
-    handled here (they're excluded from the rank rounds).
+    Returns ``(rows, resp, merged)``: the head rows of ``new_g`` with the
+    group-final remaining/status/remaining_f folded in, per-request
+    responses, and the follower rows handled here (excluded from the rank
+    rounds).
     """
     b = reqs.slot.shape[0]
     TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
@@ -271,13 +280,14 @@ def _apply_merged_followers(
     )
     group_ok = bad_per_seg[seg_id] == 0
 
-    # Post-head state of the group's slot (logical views of stored layout).
-    slot = reqs.slot
-    R0 = gather_field(state, "remaining", slot)
-    F0 = gather_field(state, "remaining_f", slot)
+    # Post-head state of the group's slot, read straight from the heads'
+    # transition output (identical to a table gather after the head
+    # scatter, minus the gather).
+    R0 = hd(new_g.remaining)
+    F0 = hd(new_g.remaining_f)
     N0 = F0.astype(jnp.int64)  # Go float64→int64 truncation
-    S0 = state.status[slot]
-    E = gather_field(state, "expire_at", slot)
+    S0 = hd(new_g.status)
+    E = hd(new_g.expire_at)
     alive = now <= E
 
     merged = group_ok & ok & alive & (rank > 0)
@@ -313,27 +323,33 @@ def _apply_merged_followers(
         over_limit=jnp.where(merged, ~under, resp.over_limit),
     )
 
-    # Final state: scattered by the last follower alone.
-    is_last = merged & (rank == group_size - 1)
+    # Group-final state, evaluated at the LAST member's rank and folded
+    # into the head's scatter row (one scatter for head + whole group).
+    li = (group_size - 1).astype(jnp.int64)
+    l_under = li <= q
+    rem_last = jnp.where(l_under, base - li * h, rem_over)
     divisible = base - q * h == 0
     # Token: stored status flips OVER once an at-zero step occurred.
-    at_zero_hit = jnp.where(divisible, i > q, drain & (i > q + 1))
-    status_final = jnp.where(at_zero_hit, OVER, S0)
-    scat_tok = jnp.where(is_last & is_tok, slot, capacity)
+    at_zero_last = jnp.where(divisible, li > q, drain & (li > q + 1))
+    status_last = jnp.where(at_zero_last, OVER, S0)
     # Leaky: the float remaining keeps its fraction through decrements but
     # collapses to exactly 0.0 after an exact-remainder step (q ≥ 1,
     # divisible, reached) or a drain step (base > 0, passed rank q).
-    zero_f = ((q >= 1) & divisible & (i >= q)) | ((base > 0) & drain & (i > q))
-    remf_final = jnp.where(
+    zero_f = ((q >= 1) & divisible & (li >= q)) | ((base > 0) & drain & (li > q))
+    remf_last = jnp.where(
         zero_f,
         jnp.float64(0.0),
-        F0 - (jnp.minimum(i, q) * h).astype(jnp.float64),
+        F0 - (jnp.minimum(li, q) * h).astype(jnp.float64),
     )
-    scat_leaky = jnp.where(is_last & ~is_tok, slot, capacity)
-    state = scatter_field(state, "remaining", scat_tok, rem_resp)
-    state = scatter_field(state, "status", scat_tok, status_final)
-    state = scatter_field(state, "remaining_f", scat_leaky, remf_final)
-    return state, resp, merged
+    head_ovr = group_ok & ok & alive & (rank == 0) & (group_size > 1)
+    rows = new_g._replace(
+        remaining=jnp.where(head_ovr & is_tok, rem_last, new_g.remaining),
+        status=jnp.where(head_ovr & is_tok, status_last, new_g.status),
+        remaining_f=jnp.where(
+            head_ovr & ~is_tok, remf_last, new_g.remaining_f
+        ),
+    )
+    return rows, resp, merged
 
 
 def make_tick_fn(capacity: int, merge_uniform: bool = True):
@@ -384,16 +400,25 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True):
             return st, resp
 
         # Round 0: every group head takes the full transition (new item,
-        # renewal, limit delta, RESET — all head-only concerns).
-        state, resp = round_step(state, resp0, reqs.valid & (rank == 0))
-
+        # renewal, limit delta, RESET — all head-only concerns).  With the
+        # merge fast path the heads' scatter rows already carry the whole
+        # group's final state, so head + followers cost ONE scatter.
+        heads = reqs.valid & (rank == 0)
+        gathered = gather_state(state, reqs.slot)
+        new_g, r_out = bucket_transition(now, gathered, reqs)
+        resp = jax.tree.map(
+            lambda old, new: jnp.where(heads, new, old), resp0, r_out
+        )
         if merge_uniform:
-            state, resp, merged = _apply_merged_followers(
-                state, resp, reqs, now, capacity,
+            rows, resp, merged = _apply_merged_followers(
+                new_g, resp, reqs, now,
                 rank, group_size, head_idx, seg_id,
             )
         else:
+            rows = new_g
             merged = jnp.zeros(b, jnp.bool_)
+        scat = jnp.where(heads, reqs.slot, capacity)
+        state = scatter_state(state, scat, rows)
 
         # Rank rounds for whatever didn't merge (mixed-parameter groups,
         # RESET/Gregorian flows, queries): round k applies at most one
