@@ -1,0 +1,89 @@
+# gubernator-tpu on GKE (the TPU-platform analog of the reference's
+# contrib/aws-ecs-service-discovery-deployment): a regional cluster, an
+# optional TPU node pool for accelerator-backed daemons, and the chart
+# from ../charts/gubernator-tpu with k8s-API peer discovery.
+
+terraform {
+  required_version = ">= 1.3"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = "~> 2.9" # 3.x changed kubernetes{}/set{} to attribute syntax
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  region  = var.region
+}
+
+resource "google_container_cluster" "gubernator" {
+  name                     = var.cluster_name
+  location                 = var.region
+  remove_default_node_pool = true
+  initial_node_count       = 1
+  deletion_protection      = false
+}
+
+resource "google_container_node_pool" "cpu" {
+  name       = "${var.cluster_name}-cpu"
+  cluster    = google_container_cluster.gubernator.id
+  node_count = var.cpu_node_count
+
+  node_config {
+    machine_type = var.cpu_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# Optional TPU node pool: schedule daemons here (values-tpu.yaml sets
+# resources.limits["google.com/tpu"]) so the bucket table lives in HBM.
+resource "google_container_node_pool" "tpu" {
+  count      = var.tpu_node_count > 0 ? 1 : 0
+  name       = "${var.cluster_name}-tpu"
+  cluster    = google_container_cluster.gubernator.id
+  node_count = var.tpu_node_count
+
+  node_config {
+    machine_type = var.tpu_machine_type # e.g. ct5lp-hightpu-1t (v5e)
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+data "google_client_config" "default" {}
+
+provider "helm" {
+  kubernetes {
+    host                   = "https://${google_container_cluster.gubernator.endpoint}"
+    token                  = data.google_client_config.default.access_token
+    cluster_ca_certificate = base64decode(google_container_cluster.gubernator.master_auth[0].cluster_ca_certificate)
+  }
+}
+
+resource "helm_release" "gubernator" {
+  name      = "gubernator"
+  chart     = "${path.module}/../charts/gubernator-tpu"
+  namespace = var.namespace
+
+  # The cluster starts with zero schedulable nodes
+  # (remove_default_node_pool); don't install until a pool exists.
+  depends_on = [google_container_node_pool.cpu]
+
+  set {
+    name  = "replicaCount"
+    value = var.replicas
+  }
+  set {
+    name  = "image.repository"
+    value = var.image_repository
+  }
+  set {
+    name  = "image.tag"
+    value = var.image_tag
+  }
+}

@@ -1,0 +1,58 @@
+variable "project" {
+  description = "GCP project id"
+  type        = string
+}
+
+variable "region" {
+  description = "GKE region (pick one with v5e capacity for TPU pools)"
+  type        = string
+  default     = "us-west4"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "gubernator-tpu"
+}
+
+variable "namespace" {
+  type    = string
+  default = "default"
+}
+
+variable "replicas" {
+  description = "Number of gubernator-tpu daemons"
+  type        = number
+  default     = 4
+}
+
+variable "image_repository" {
+  type    = string
+  default = "gubernator-tpu"
+}
+
+variable "image_tag" {
+  type    = string
+  default = "latest"
+}
+
+variable "cpu_node_count" {
+  type    = number
+  default = 3
+}
+
+variable "cpu_machine_type" {
+  type    = string
+  default = "e2-standard-4"
+}
+
+variable "tpu_node_count" {
+  description = "0 disables the TPU pool (daemons run the XLA CPU backend)"
+  type        = number
+  default     = 0
+}
+
+variable "tpu_machine_type" {
+  description = "TPU VM machine type (v5e single-host)"
+  type        = string
+  default     = "ct5lp-hightpu-1t"
+}

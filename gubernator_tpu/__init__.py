@@ -32,7 +32,7 @@ from gubernator_tpu.types import (  # noqa: E402
     RateLimitResponse,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Algorithm",

@@ -1,0 +1,93 @@
+"""Client convenience surface (reference client.go + python/gubernator).
+
+The framework's full async client lives in
+:class:`gubernator_tpu.transport.daemon.DaemonClient`; this module adds
+the small helpers the reference ships for callers — duration constants,
+millisecond-timestamp converters (client.go:70-86), ``sleep_until_reset``
+(python/gubernator/__init__.py:14), peer/string randomizers
+(client.go:89-105) — and a ``dial_v1`` that mirrors ``DialV1Server``
+(client.go:44-65: optional TLS, tracing-instrumented channel).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import secrets
+import string
+import time
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.utils import timeutil
+
+MILLISECOND = 1
+SECOND = 1000 * MILLISECOND
+MINUTE = 60 * SECOND
+
+
+def to_timestamp(duration_s: float) -> int:
+    """Seconds → the millisecond duration/reset_time unit of the API
+    (client.go:70 ToTimeStamp, from Go's time.Duration)."""
+    return int(duration_s * 1000)
+
+
+def from_timestamp(ts_ms: int) -> float:
+    """Unix-ms timestamp → seconds from now (client.go:76 FromTimeStamp);
+    negative when ``ts_ms`` is in the future."""
+    return (timeutil.now_ms() - ts_ms) / 1000.0
+
+
+def from_unix_milliseconds(ts_ms: int) -> float:
+    """Unix-ms timestamp → unix seconds (client.go:84)."""
+    return ts_ms / 1000.0
+
+
+def sleep_until_reset(reset_time_ms: int) -> None:
+    """Block until a response's ``reset_time`` has passed
+    (python/gubernator/__init__.py:14)."""
+    delta = reset_time_ms - timeutil.now_ms()
+    if delta > 0:
+        time.sleep(delta / 1000.0)
+
+
+async def asleep_until_reset(reset_time_ms: int) -> None:
+    """Async variant of :func:`sleep_until_reset`."""
+    delta = reset_time_ms - timeutil.now_ms()
+    if delta > 0:
+        await asyncio.sleep(delta / 1000.0)
+
+
+def random_peer(peers: Sequence[PeerInfo]) -> PeerInfo:
+    """A random peer from the list (client.go:89 RandomPeer)."""
+    return random.choice(list(peers))
+
+
+def random_string(n: int) -> str:
+    """Random alphanumeric string of length ``n`` (client.go:97),
+    crypto-sourced like the reference."""
+    alphabet = string.digits + string.ascii_uppercase + string.ascii_lowercase
+    return "".join(secrets.choice(alphabet) for _ in range(n))
+
+
+def dial_v1(server: str, tls=None):
+    """Connect to a daemon, returning the async client
+    (reference DialV1Server, client.go:44-65).
+
+    ``tls`` may be a :class:`gubernator_tpu.transport.tlsutil.TLSBundle`
+    (client credentials derived from it) or ready-made
+    ``grpc.ChannelCredentials``.
+    """
+    import grpc
+
+    from gubernator_tpu.transport.daemon import DaemonClient
+
+    if not server:
+        raise ValueError("server is empty; must provide a server")
+    creds = None
+    if tls is not None:
+        creds = (
+            tls if isinstance(tls, grpc.ChannelCredentials)
+            else tls.channel_credentials()
+        )
+    return DaemonClient(server, credentials=creds)

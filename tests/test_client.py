@@ -1,0 +1,73 @@
+"""Client helper surface (reference client.go + python client parity)."""
+
+import time
+
+import pytest
+
+from gubernator_tpu import client
+from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.utils import timeutil
+
+
+def test_duration_constants():
+    assert client.SECOND == 1000 and client.MINUTE == 60_000
+
+
+def test_timestamp_converters():
+    assert client.to_timestamp(1.5) == 1500
+    now = timeutil.now_ms()
+    assert abs(client.from_timestamp(now - 2000) - 2.0) < 0.1
+    assert client.from_unix_milliseconds(1500) == 1.5
+
+
+def test_sleep_until_reset_blocks_until_reset():
+    t0 = time.perf_counter()
+    client.sleep_until_reset(timeutil.now_ms() + 120)
+    assert time.perf_counter() - t0 >= 0.1
+    # Past reset: returns immediately.
+    t0 = time.perf_counter()
+    client.sleep_until_reset(timeutil.now_ms() - 5000)
+    assert time.perf_counter() - t0 < 0.05
+
+
+async def test_asleep_until_reset():
+    t0 = time.perf_counter()
+    await client.asleep_until_reset(timeutil.now_ms() + 120)
+    assert time.perf_counter() - t0 >= 0.1
+
+
+def test_random_helpers():
+    peers = [PeerInfo(grpc_address=f"h{i}:81") for i in range(5)]
+    assert client.random_peer(peers) in peers
+    s = client.random_string(24)
+    assert len(s) == 24 and s.isalnum()
+
+
+def test_dial_v1_rejects_empty():
+    with pytest.raises(ValueError):
+        client.dial_v1("")
+
+
+async def test_dial_v1_roundtrip():
+    from gubernator_tpu.config import BehaviorConfig, Config, DaemonConfig
+    from gubernator_tpu.transport.daemon import spawn_daemon
+    from gubernator_tpu.types import RateLimitRequest
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="",
+        peer_discovery_type="none",
+    )
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=256)
+    d = await spawn_daemon(conf)
+    try:
+        c = client.dial_v1(d.advertise_address)
+        out = await c.get_rate_limits([RateLimitRequest(
+            name="svc", unique_key="k", hits=1, limit=10, duration=60_000)])
+        assert out[0].remaining == 9
+        await client.asleep_until_reset(
+            min(out[0].reset_time, timeutil.now_ms() + 50)
+        )
+        await c.close()
+    finally:
+        await d.close()
