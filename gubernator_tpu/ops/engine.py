@@ -42,6 +42,7 @@ from gubernator_tpu.ops.buckets import (
     gather_field,
     gather_state,
     np_logical,
+    to_logical,
     scatter_field,
     scatter_state,
 )
@@ -728,29 +729,51 @@ class SlotMap:
         return out
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_dead_scan():
+    """Device-side TTL sweep: ``~in_use | expired`` packed to a bitmask so
+    the per-reclaim D2H is capacity/8 bytes, not the 9 bytes/slot the old
+    host sweep copied (seconds of stall at 10M slots over a tunneled
+    device)."""
+
+    def scan(in_use, exp_lo, exp_hi, now):
+        exp = to_logical((exp_lo, exp_hi), "expire_at")
+        dead = (~in_use) | (exp < now)
+        return jnp.packbits(dead, bitorder="little")
+
+    return jax.jit(scan)
+
+
+def device_dead_mask(in_use, expire_field, now: int, capacity: int) -> np.ndarray:
+    """Host bool mask of device-dead slots (unused or TTL-expired), computed
+    on device and shipped as a packed bitmask."""
+    lo, hi = expire_field
+    bits = np.asarray(_jitted_dead_scan()(in_use, lo, hi, jnp.int64(now)))
+    return np.unpackbits(bits, count=capacity, bitorder="little").astype(bool)
+
+
 def select_reclaim_victims(
     mapped: np.ndarray,
-    in_use: np.ndarray,
-    expire: np.ndarray,
+    dead_dev: np.ndarray,
     last_access: np.ndarray,
     tick_count: int,
-    now: int,
     want: int,
 ) -> tuple[np.ndarray, np.ndarray]:
     """TTL-then-LRU victim selection over a table (or a shard slice of one).
 
     The one reclaim policy shared by all engines (expired-on-read eviction +
     evict-oldest of lrucache.go:88-149): returns ``(expired, lru_victims)``
-    as local slot indices.  Expired slots release host-side with no device
-    work; LRU victims must *also* be device-evicted (their ``in_use`` is
-    still set, and stale state must not resurrect if the slot is reused).
+    as local slot indices.  ``dead_dev`` is the device's view of dead slots
+    (:func:`device_dead_mask`).  Expired slots release host-side with no
+    device work; LRU victims must *also* be device-evicted (their ``in_use``
+    is still set, and stale state must not resurrect if the slot is reused).
 
     ``mapped`` must already exclude host-pending slots (assigned but not
     yet written by a tick); slots touched this tick are excluded here —
     both look dead on device but are live.
     """
     mapped = mapped & (last_access != tick_count)
-    dead = mapped & (~in_use | (expire < now))
+    dead = mapped & dead_dev
     freed = np.flatnonzero(dead)
     none = np.empty(0, np.int64)
     if len(freed) >= want:
@@ -862,11 +885,11 @@ class TickEngine:
             mapped[np.fromiter(self._pending, np.int64)] = False
         freed, victims = select_reclaim_victims(
             mapped,
-            np.asarray(self.state.in_use),
-            np_logical(self.state.expire_at, "expire_at"),
+            device_dead_mask(
+                self.state.in_use, self.state.expire_at, now, self.capacity
+            ),
             self._last_access,
             self._tick_count,
-            now,
             want or max(1, self.capacity // 16),
         )
         self.slots.release_batch(freed)
@@ -930,7 +953,11 @@ class TickEngine:
             ok = slots >= 0
             self._last_access[slots[ok]] = self._tick_count
             self._pending.update(slots[ok & (known == 0)].tolist())
-            self._reclaim(now)
+            # Free at least as many slots as this batch still needs — the
+            # capacity//16 default can be smaller than one batch's misses,
+            # which would fail the retry with room still reclaimable.
+            needed = int((~ok).sum())
+            self._reclaim(now, want=max(needed, self.capacity // 16))
             retry = np.flatnonzero(slots < 0)
             s2, k2 = self.slots.resolve_batch([keys[j] for j in retry])
             slots[retry] = s2

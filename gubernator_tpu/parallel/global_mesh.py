@@ -559,18 +559,22 @@ class MeshGlobalEngine:
         slice, read node 0's replica — correct at reconcile boundaries and
         conservatively stale (never early) between them.
         """
-        from gubernator_tpu.ops.engine import select_reclaim_victims
+        from gubernator_tpu.ops.engine import (
+            device_dead_mask,
+            select_reclaim_victims,
+        )
 
         mapped = self.slots.mapped_mask()
         if self._pending:
             mapped[np.fromiter(self._pending, np.int64)] = False
         freed, victims = select_reclaim_victims(
             mapped,
-            np.asarray(self.state.in_use[0]),
-            np_logical(slice_field(self.state.expire_at, 0), "expire_at"),
+            device_dead_mask(
+                self.state.in_use[0], slice_field(self.state.expire_at, 0),
+                now, self.capacity,
+            ),
             self._last_access,
             self._tick_count,
-            now,
             max(1, self.capacity // 16),
         )
         self.slots.release_batch(freed)

@@ -38,6 +38,7 @@ from gubernator_tpu.ops.buckets import BucketState, np_logical, slice_field
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
+    device_dead_mask,
     items_from_columns,
     make_evict_fn,
     make_install_fn,
@@ -185,15 +186,16 @@ class MeshTickEngine:
             pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
             if pend:
                 mapped[np.asarray(pend, np.int64)] = False
+        sl = slice(lo, lo + self.local_capacity)
         freed, victims = select_reclaim_victims(
             mapped,
-            np.asarray(self.state.in_use[lo : lo + self.local_capacity]),
-            np_logical(slice_field(
-                self.state.expire_at, slice(lo, lo + self.local_capacity)
-            ), "expire_at"),
-            self._last_access[lo : lo + self.local_capacity],
+            device_dead_mask(
+                self.state.in_use[sl],
+                slice_field(self.state.expire_at, sl),
+                now, self.local_capacity,
+            ),
+            self._last_access[sl],
             self._tick_count,
-            now,
             max(1, self.local_capacity // 16),
         )
         sm.release_batch(freed)

@@ -137,3 +137,24 @@ def test_empty_batch():
 
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])
+
+
+def test_reclaim_frees_enough_for_whole_batch():
+    """A batch whose misses exceed the capacity//16 reclaim quantum must
+    still land: the retry reclaim sizes itself to the batch's need."""
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    now = 1_700_000_000_000
+    eng = TickEngine(capacity=256, max_batch=128)
+
+    def req(k):
+        return RateLimitRequest(name="n", unique_key=k, hits=1, limit=10,
+                                duration=3_600_000)
+
+    for start in (0, 128, 256):  # third batch LRU-evicts 128 > 256//16
+        rs = eng.process([req(f"c{start + i}") for i in range(128)], now=now)
+        assert all(r.error == "" for r in rs)
+    assert eng.metric_unexpired_evictions >= 128
+    # Evicted state must not resurrect on slot reuse.
+    assert eng.process([req("c0")], now=now)[0].remaining == 9
