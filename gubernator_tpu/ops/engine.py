@@ -782,7 +782,31 @@ def select_reclaim_victims(
     n = min(want - len(freed), len(live))
     if n <= 0:
         return freed, none
-    return freed, live[np.argsort(last_access[live])[:n]]
+    if n >= len(live):
+        return freed, live
+    # argpartition, not argsort: O(live) — a full sort of a 10M-slot table
+    # costs seconds per reclaim for ordering we don't need.
+    return freed, live[np.argpartition(last_access[live], n - 1)[:n]]
+
+
+EVICT_CHUNK = 1 << 16
+
+
+def evict_chunked(evict_fn, state, victims: np.ndarray, capacity: int):
+    """Apply a device evict scatter in width-capped chunks.
+
+    Padding the whole batch to ``pad_pow2(len(victims))`` would compile an
+    unbounded program width — including a ~1M-wide one on the first
+    big-table reclaim (tens of seconds of jit on a slow toolchain).
+    Capping at EVICT_CHUNK bounds compiles to the log2(EVICT_CHUNK) small
+    widths, each cheap to build and shared via jit's shape cache."""
+    for start in range(0, len(victims), EVICT_CHUNK):
+        part = victims[start : start + EVICT_CHUNK]
+        w = min(EVICT_CHUNK, pad_pow2(len(part)))
+        padded = np.full(w, capacity, np.int32)
+        padded[: len(part)] = part
+        state = evict_fn(state, jnp.asarray(padded))
+    return state
 
 
 def make_slot_map(capacity: int):
@@ -822,6 +846,14 @@ class TickEngine:
                 jnp.asarray, BucketState.zeros(self.capacity)
             )
         self._tick = _jitted_tick(self.capacity)
+        # Tick widths: one narrow program for typical service batches
+        # (≤ the reference's 1000-item batch limit) plus the full width.
+        # Singleton for small engines so test clusters don't pay an extra
+        # compile per daemon.
+        mb = pad_pow2(self.max_batch)
+        self._widths = (
+            (mb,) if mb < 2048 else tuple(sorted({max(1024, mb // 4), mb}))
+        )
         self._evict = _jitted_evict()
         self._install = _jitted_install()
         self._restore = _jitted_restore()
@@ -851,10 +883,13 @@ class TickEngine:
         measured) — unwarmed, that lands on the first live request, blows
         the 500ms peer batch_timeout, and triggers forward retries that
         double-count hits."""
-        m = np.zeros((len(REQ_ROWS), self.max_batch), np.int64)
-        m[REQ_ROW_INDEX["slot"]] = self.capacity
-        self.state, resp = self._tick(self.state, jnp.asarray(m), jnp.int64(0))
-        np.asarray(resp)
+        for w in self._widths:
+            m = np.zeros((len(REQ_ROWS), w), np.int64)
+            m[REQ_ROW_INDEX["slot"]] = self.capacity
+            self.state, resp = self._tick(
+                self.state, jnp.asarray(m), jnp.int64(0)
+            )
+            np.asarray(resp)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
         jax.block_until_ready(self.state)
@@ -897,9 +932,7 @@ class TickEngine:
             return
         self.metric_unexpired_evictions += len(victims)
         self.slots.release_batch(victims)
-        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
-        padded[: len(victims)] = victims
-        self.state = self._evict(self.state, jnp.asarray(padded))
+        self.state = evict_chunked(self._evict, self.state, victims, self.capacity)
 
     def build_batch(
         self, requests: Sequence[RateLimitRequest], now: int
@@ -912,7 +945,11 @@ class TickEngine:
         n = len(requests)
         if n > self.max_batch:
             raise ValueError(f"batch of {n} exceeds engine max {self.max_batch}")
-        b = self.max_batch
+        # Width quantization: a tick's device cost scales with the padded
+        # width (scatter lanes), so small batches use the narrow program
+        # instead of paying for max_batch lanes of padding.  Both widths
+        # are compiled at warmup.
+        b = next(w for w in self._widths if w >= n)
         m = np.zeros((len(REQ_ROWS), b), np.int64)
         R = REQ_ROW_INDEX
         m[R["slot"]] = self.capacity  # padding scatters out of bounds

@@ -581,10 +581,14 @@ class MeshGlobalEngine:
         if len(victims) == 0:
             return
         self.slots.release_batch(victims)
-        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
-        padded[: len(victims)] = victims
-        self.state, self.aux, self.accum = self._evict(
-            self.state, self.aux, self.accum, jnp.asarray(padded)
+        from gubernator_tpu.ops.engine import evict_chunked
+
+        def _evict3(bundle, padded):
+            st, aux, acc = bundle
+            return self._evict(st, aux, acc, padded)
+
+        self.state, self.aux, self.accum = evict_chunked(
+            _evict3, (self.state, self.aux, self.accum), victims, self.capacity
         )
 
     # ------------------------------------------------------------------

@@ -39,6 +39,7 @@ from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
     device_dead_mask,
+    evict_chunked,
     items_from_columns,
     make_evict_fn,
     make_install_fn,
@@ -202,9 +203,9 @@ class MeshTickEngine:
         if len(victims) == 0:
             return
         sm.release_batch(victims)
-        padded = np.full(pad_pow2(len(victims)), self.capacity, np.int32)
-        padded[: len(victims)] = lo + victims
-        self.state = self._evict(self.state, jnp.asarray(padded))
+        self.state = evict_chunked(
+            self._evict, self.state, lo + victims, self.capacity
+        )
 
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
