@@ -73,7 +73,7 @@ from gubernator_tpu.ops.buckets import (
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
-    _rank_within_slot,
+    _slot_segments,
     make_slot_map,
     pack_request_col,
     pack_resp,
@@ -137,45 +137,54 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
         r = unpack_reqs(reqs_blk[0])
         my = lax.axis_index("node")
 
-        rank = _rank_within_slot(r.slot, r.valid, capacity)
+        rank, group_size, _, _ = _slot_segments(r.slot, r.valid, capacity)
         n_rounds = jnp.max(jnp.where(r.valid, rank, 0)) + 1
         b = r.slot.shape[0]
         resp0 = (
             jnp.zeros(b, I32), jnp.zeros(b, I64), jnp.zeros(b, I64),
             jnp.zeros(b, I64), jnp.zeros(b, jnp.bool_),
         )
-        aux_vals = jnp.stack([
-            r.limit, r.duration, r.algorithm.astype(I64),
-            r.behavior.astype(I64), r.burst, r.greg_exp, r.greg_dur,
-            r.created_at, jnp.full_like(r.limit, stamp),
-        ])
 
         def cond(carry):
-            k, _, _, _ = carry
+            k, _, _ = carry
             return k < n_rounds
 
         def body(carry):
-            k, st, aux, resp = carry
+            k, st, resp = carry
             active = r.valid & (rank == k)
             gathered = gather_state(st, r.slot)
             new_g, r_out = bucket_transition(now, gathered, r)
             scat = jnp.where(active, r.slot, capacity)
             st = scatter_state(st, scat, new_g)
-            aux = aux.at[:, scat].set(aux_vals, mode="drop")
             new_resp = (r_out.status, r_out.limit, r_out.remaining,
                         r_out.reset_time, r_out.over_limit)
             resp = tuple(
                 jnp.where(active, n, o) for n, o in zip(new_resp, resp)
             )
-            return k + 1, st, aux, resp
+            return k + 1, st, resp
 
-        _, st, aux, resp = lax.while_loop(
-            cond, body, (jnp.int32(0), st, aux, resp0)
+        _, st, resp = lax.while_loop(cond, body, (jnp.int32(0), st, resp0))
+
+        # Aux params: one last-writer scatter per tick, not one per round —
+        # every round would write the same per-slot "latest request" row the
+        # final rank writes anyway, and the (9, B) int64 scatter is the
+        # most expensive op in the program.
+        aux_vals = jnp.stack([
+            r.limit, r.duration, r.algorithm.astype(I64),
+            r.behavior.astype(I64), r.burst, r.greg_exp, r.greg_dur,
+            r.created_at, jnp.full_like(r.limit, stamp),
+        ])
+        tail = r.valid & (rank == group_size - 1)
+        aux = aux.at[:, jnp.where(tail, r.slot, capacity)].set(
+            aux_vals, mode="drop"
         )
 
         # Hit accumulation for non-owned slots (global.go:99-112): sum hits,
         # OR RESET_REMAINING, count contributions.  Zero-hit queries are not
         # queued (global.go:74-78).  Order-independent → one scatter-add.
+        # int64 accumulators: narrowing to int32 would wrap (not saturate)
+        # under accumulated hits across a window — a credit-instead-of-
+        # drain bypass — so the slower 64-bit scatter-add stays.
         owned = (r.slot // slice_sz) == my.astype(I32)
         queue = r.valid & ~owned & (r.hits != 0)
         qslot = jnp.where(queue, r.slot, capacity)
