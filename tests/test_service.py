@@ -263,3 +263,92 @@ async def test_http_gateway_snake_case():
             assert "gubernator_cache_size" in text
     finally:
         await c.stop()
+
+
+async def _wait_replica(daemon, name, key, limit, want_remaining,
+                        timeout=5.0):
+    """Poll one daemon's GLOBAL replica until it reports ``want_remaining``.
+
+    The broadcast metric alone can't prove delivery to a *specific* peer
+    (push failures are swallowed and retried next interval), so state
+    assertions poll the replica itself."""
+    async def poll():
+        while True:
+            cl = daemon.client()
+            r = (await cl.get_rate_limits(
+                [req(name=name, key=key, hits=0, limit=limit,
+                     duration=6_000_000, behavior=Behavior.GLOBAL)]
+            ))[0]
+            await cl.close()
+            if r.remaining == want_remaining:
+                return
+            await asyncio.sleep(0.02)
+
+    await asyncio.wait_for(poll(), timeout=timeout)
+
+
+async def test_global_peer_over_limit():
+    """Non-owner replica drains to OVER_LIMIT through owner broadcasts
+    (functional_test.go:1093 TestGlobalRateLimitsPeerOverLimit)."""
+    behaviors = BehaviorConfig(global_sync_wait=0.05, batch_wait=0.002)
+    c = await Cluster.start(3, behaviors=behaviors)
+    try:
+        name, key = "global-over", "pk"
+        peer = c.list_non_owning_daemons(name, key)[0]
+        client = peer.client()
+
+        async def send_hit(hits, want_status, want_remaining):
+            r = (await client.get_rate_limits(
+                [req(name=name, key=key, hits=hits, limit=2,
+                     duration=300_000, behavior=Behavior.GLOBAL)]
+            ))[0]
+            assert r.error == ""
+            assert (r.status, r.remaining) == (want_status, want_remaining), r
+
+        await send_hit(1, Status.UNDER_LIMIT, 1)
+        await send_hit(1, Status.UNDER_LIMIT, 0)
+        # Wait for the authoritative drained state to land on THIS peer
+        # (broadcasts may split across windows and pushes may retry).
+        await _wait_replica(peer, name, key, 2, 0)
+        await send_hit(1, Status.OVER_LIMIT, 0)
+        await send_hit(1, Status.OVER_LIMIT, 0)
+        await client.close()
+    finally:
+        await c.stop()
+
+
+async def test_global_negative_hits():
+    """Negative GLOBAL hits credit tokens back across the cluster
+    (functional_test.go:1204 TestGlobalNegativeHits)."""
+    behaviors = BehaviorConfig(global_sync_wait=0.05, batch_wait=0.002)
+    c = await Cluster.start(4, behaviors=behaviors)
+    try:
+        name, key = "global-neg", "nk"
+        peers = c.list_non_owning_daemons(name, key)
+
+        async def send_hit(daemon, hits, want_remaining):
+            cl = daemon.client()
+            r = (await cl.get_rate_limits(
+                [req(name=name, key=key, hits=hits, limit=2,
+                     duration=6_000_000, behavior=Behavior.GLOBAL)]
+            ))[0]
+            await cl.close()
+            assert r.error == ""
+            assert r.status == Status.UNDER_LIMIT
+            assert r.remaining == want_remaining, (hits, r)
+
+        # Negative hit on an empty bucket: remaining grows past the limit.
+        await send_hit(peers[0], -1, 3)
+        # Wait for the credit to replicate to the NEXT peer we'll hit —
+        # the broadcast metric can't prove per-peer delivery.
+        await _wait_replica(peers[1], name, key, 2, 3)
+        # That peer sees the credited 3, credits one more.
+        await send_hit(peers[1], -1, 4)
+        await _wait_replica(peers[2], name, key, 2, 4)
+        # A third peer can spend all 4 credits at once.
+        await send_hit(peers[2], 4, 0)
+        await _wait_replica(peers[0], name, key, 2, 0)
+        # Query reflects the drained state everywhere.
+        await send_hit(peers[0], 0, 0)
+    finally:
+        await c.stop()
