@@ -892,6 +892,10 @@ class TickEngine:
             np.asarray(resp)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
+        # Compile the reclaim dead-scan now too: its first invocation
+        # otherwise jits a capacity-wide program on the serving path, right
+        # when the table first fills (tens of seconds on slow toolchains).
+        device_dead_mask(self.state.in_use, self.state.expire_at, 0, self.capacity)
         jax.block_until_ready(self.state)
 
     # ------------------------------------------------------------------

@@ -453,6 +453,13 @@ class MeshGlobalEngine:
         self.state, self.accum = self._recon(
             self.state, self.aux, self.accum, jnp.int64(0)
         )
+        # Pre-compile the reclaim dead-scan (see TickEngine._warmup).
+        from gubernator_tpu.ops.engine import device_dead_mask
+
+        device_dead_mask(
+            self.state.in_use[0], slice_field(self.state.expire_at, 0),
+            0, self.capacity,
+        )
         jax.block_until_ready(self.state)
 
     # ------------------------------------------------------------------

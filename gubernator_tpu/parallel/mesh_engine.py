@@ -150,6 +150,12 @@ class MeshTickEngine:
         np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
+        # Pre-compile the per-shard reclaim dead-scan (see TickEngine._warmup).
+        sl = slice(0, self.local_capacity)
+        device_dead_mask(
+            self.state.in_use[sl], slice_field(self.state.expire_at, sl),
+            0, self.local_capacity,
+        )
         jax.block_until_ready(self.state)
 
     def _shard_of(self, key: str) -> int:
