@@ -163,6 +163,9 @@ class DaemonConfig:
     log_level: str = "info"
     log_format: str = "text"
     metric_flags: int = 0
+    # Max age of a gRPC client connection in seconds; 0 = infinity
+    # (reference config.go:319 GRPCMaxConnectionAgeSeconds).
+    grpc_max_conn_age_sec: int = 0
 
     # member-list discovery
     memberlist_address: str = ""
@@ -354,6 +357,7 @@ def setup_daemon_config(
         log_level=r.str_("GUBER_LOG_LEVEL", "info"),
         log_format=r.str_("GUBER_LOG_FORMAT", "text"),
         metric_flags=parse_metric_flags(r.list_("GUBER_METRIC_FLAGS")),
+        grpc_max_conn_age_sec=r.int_("GUBER_GRPC_MAX_CONN_AGE_SEC", 0),
         memberlist_address=r.str_("GUBER_MEMBERLIST_ADDRESS"),
         memberlist_advertise_address=r.str_("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
         memberlist_known_nodes=r.list_("GUBER_MEMBERLIST_KNOWN_NODES"),

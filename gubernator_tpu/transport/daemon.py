@@ -188,12 +188,17 @@ class Daemon:
     async def start(self) -> None:
         """Bring up instance, gRPC, gateway, discovery (daemon.go:83-366)."""
         self.tls = setup_tls(self.conf.tls)
+        options = [("grpc.max_receive_message_length", MAX_RECV_BYTES)]
+        if self.conf.grpc_max_conn_age_sec > 0:
+            # Reference parity (daemon.go:128-133): default is infinity;
+            # when set, age AND grace both apply so long-lived streams on
+            # aged connections are force-closed too.
+            age_ms = self.conf.grpc_max_conn_age_sec * 1000
+            options.append(("grpc.max_connection_age_ms", age_ms))
+            options.append(("grpc.max_connection_age_grace_ms", age_ms))
         server = grpc.aio.server(
             interceptors=[_StatsInterceptor(self.metrics), _TraceInterceptor()],
-            options=[
-                ("grpc.max_receive_message_length", MAX_RECV_BYTES),
-                ("grpc.max_connection_age_ms", 60 * 60 * 1000),
-            ],
+            options=options,
         )
         if self.tls is not None:
             port = server.add_secure_port(

@@ -128,3 +128,32 @@ async def test_daemon_exposes_flag_collectors():
         assert "python_gc_objects_collected_total" in text
     finally:
         await d.close()
+
+
+async def test_grpc_max_conn_age_env():
+    """GUBER_GRPC_MAX_CONN_AGE_SEC parity (config.go:319): default 0 =
+    infinity; a positive value serves traffic with age+grace applied."""
+    from gubernator_tpu.config import BehaviorConfig, Config, setup_daemon_config
+    from gubernator_tpu.transport.daemon import DaemonClient, spawn_daemon
+    from gubernator_tpu.types import RateLimitRequest
+
+    assert setup_daemon_config(environ={}).grpc_max_conn_age_sec == 0
+    conf = setup_daemon_config(
+        environ={"GUBER_GRPC_MAX_CONN_AGE_SEC": "30"}
+    )
+    assert conf.grpc_max_conn_age_sec == 30
+
+    # The daemon boots with the option set and serves normally.
+    conf.grpc_listen_address = "127.0.0.1:0"
+    conf.http_listen_address = ""
+    conf.peer_discovery_type = "none"
+    conf.config = Config(behaviors=BehaviorConfig(), cache_size=256)
+    d = await spawn_daemon(conf)
+    try:
+        c = DaemonClient(d.advertise_address)
+        out = await c.get_rate_limits([RateLimitRequest(
+            name="age", unique_key="k", hits=1, limit=5, duration=60_000)])
+        assert out[0].remaining == 4
+        await c.close()
+    finally:
+        await d.close()
