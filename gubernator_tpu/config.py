@@ -285,6 +285,11 @@ def setup_daemon_config(
     env = dict(os.environ) if environ is None else dict(environ)
     if config_file:
         load_config_file(config_file, env)
+    # Re-apply the compile-cache knob: a config file loads into the
+    # environment after the import-time default was chosen.
+    from gubernator_tpu import configure_compile_cache
+
+    configure_compile_cache(env)
     r = EnvReader(env)
 
     behaviors = BehaviorConfig(

@@ -177,3 +177,32 @@ def test_daemon_main_boots_and_serves():
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_compile_cache_configured_by_default(tmp_path):
+    """The package enables the persistent XLA compile cache unless
+    disabled; daemon restarts must not re-pay tick compiles."""
+
+    def cache_env(**extra):
+        env = _env(HOME=str(tmp_path), **extra)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        return env
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax, gubernator_tpu;"
+         "print(jax.config.jax_compilation_cache_dir or '')"],
+        env=cache_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert ".cache/gubernator-tpu/xla" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax, gubernator_tpu;"
+         "print(repr(jax.config.jax_compilation_cache_dir))"],
+        env=cache_env(GUBER_COMPILE_CACHE_DIR="off"),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "None"
