@@ -42,7 +42,12 @@ def configure_compile_cache(environ=None) -> None:
         jax.config.update("jax_compilation_cache_dir", None)
         return
     if env.get("JAX_COMPILATION_CACHE_DIR"):
-        return  # jax reads this itself
+        # jax bound this option at import time; a -config file loads the
+        # env var after import, so re-apply it explicitly.
+        jax.config.update(
+            "jax_compilation_cache_dir", env["JAX_COMPILATION_CACHE_DIR"]
+        )
+        return
     cache_dir = cache_dir or os.path.join(
         os.path.expanduser("~"), ".cache", "gubernator-tpu", "xla"
     )

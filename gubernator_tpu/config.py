@@ -75,6 +75,9 @@ class Config:
     tpu_max_batch: int = 4096        # request columns per device tick
     tpu_mesh_shards: int = 0         # 0 = single-chip TickEngine; N = mesh
     tpu_platform: str = ""           # force jax platform ("cpu" for tests)
+    # Bucket-table storage: "auto" picks the Pallas row layout on TPU for
+    # tables it fits (ops/rowtable.py), "columns"/"row" force one.
+    tpu_table_layout: str = "auto"   # GUBER_TPU_TABLE_LAYOUT
     # GLOBAL reconciliation over the device mesh (collectives data plane,
     # parallel/global_mesh.py): N logical peer-nodes; 0 = gRPC loops only.
     # Node index -1 = auto (jax.process_index(), the multi-host identity).
@@ -310,6 +313,7 @@ def setup_daemon_config(
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
         instance_id=r.str_("GUBER_INSTANCE_ID"),
         tpu_max_batch=r.int_("GUBER_TPU_MAX_BATCH", 4096),
+        tpu_table_layout=r.str_("GUBER_TPU_TABLE_LAYOUT", "auto"),
         tpu_mesh_shards=r.int_("GUBER_TPU_MESH_SHARDS", 0),
         tpu_platform=r.str_("GUBER_TPU_PLATFORM"),
         tpu_global_mesh_nodes=r.int_("GUBER_TPU_GLOBAL_MESH_NODES", 0),

@@ -68,6 +68,7 @@ class InstanceConfig:
     tpu_max_batch: int = 4096
     tpu_mesh_shards: int = 0             # 0 = single-chip engine
     tpu_platform: str = ""               # force jax platform ("cpu" for tests)
+    tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
     # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
     # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
     # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
@@ -93,6 +94,7 @@ class InstanceConfig:
             tpu_max_batch=conf.tpu_max_batch,
             tpu_mesh_shards=conf.tpu_mesh_shards,
             tpu_platform=conf.tpu_platform,
+            tpu_table_layout=conf.tpu_table_layout,
             tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
             tpu_global_mesh_node=conf.tpu_global_mesh_node,
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
@@ -133,6 +135,7 @@ def _make_engine(conf: InstanceConfig):
         capacity=conf.cache_size,
         max_batch=conf.tpu_max_batch,
         store=conf.store,
+        table_layout=conf.tpu_table_layout,
     )
 
 
