@@ -80,8 +80,15 @@ def rung_kernel():
     m[rows["created_at"]] = now
     m[rows["valid"]] = 1
 
-    tick = make_tick_fn(capacity)
-    state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
+    # Measure the production hot path: the row layout on TPU (Pallas
+    # per-row DMA, ops/rowtable.py), columns elsewhere.
+    from gubernator_tpu.ops.engine import make_layout_choice
+    from gubernator_tpu.ops.rowtable import RowState
+
+    layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
+    tick = make_tick_fn(capacity, layout=layout)
+    zeros = RowState.zeros if layout == "row" else BucketState.zeros
+    state = jax.tree.map(jnp.asarray, zeros(capacity))
     packed = jnp.asarray(m)
 
     # Honest timing on a tunneled device requires BOTH: (a) chaining ticks

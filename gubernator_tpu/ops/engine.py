@@ -68,13 +68,20 @@ from gubernator_tpu.utils import timeutil, tracing
 ROW_LAYOUT_MAX_BYTES = 6 << 30  # beyond this, fall back to columns
 
 
-def make_layout_choice(layout: str, capacity: int, device) -> str:
-    """Resolve an engine ``table_layout`` setting ("auto"/"row"/"columns")."""
+def make_layout_choice(layout: str, capacity: int, device,
+                       max_batch: int = 0) -> str:
+    """Resolve an engine ``table_layout`` setting ("auto"/"row"/"columns").
+
+    ``max_batch`` participates because the row kernels stage the whole
+    request block in VMEM (512 B/row): widths past 64k rows don't fit
+    alongside the double-buffered pipeline, so auto falls back."""
     if layout == "auto":
         row_bytes = (capacity + 1) * rowtable.ROW_W * 4
         return (
             "row"
-            if device.platform == "tpu" and row_bytes <= ROW_LAYOUT_MAX_BYTES
+            if device.platform == "tpu"
+            and row_bytes <= ROW_LAYOUT_MAX_BYTES
+            and pad_pow2(max_batch or 1) <= EVICT_CHUNK
             else "columns"
         )
     if layout not in ("row", "columns"):
@@ -888,6 +895,7 @@ def select_reclaim_victims(
 
 
 EVICT_CHUNK = 1 << 16
+RESTORE_CHUNK = 1 << 15  # bounds the per-call VMEM row staging (16 MB)
 
 
 def evict_chunked(evict_fn, state, victims: np.ndarray, capacity: int):
@@ -941,7 +949,7 @@ class TickEngine:
         self.store = store
         self.device = device or jax.devices()[0]
         self.layout = make_layout_choice(
-            table_layout, self.capacity, self.device
+            table_layout, self.capacity, self.device, self.max_batch
         )
         zeros, _, _ = _layout_ops(self.layout)
         with jax.default_device(self.device):
@@ -1289,7 +1297,12 @@ class TickEngine:
             # tick still satisfy the "touched this tick" reclaim guard and
             # LRU eviction can't free anything.
             self._tick_count += 1
-            rows = []
+            # Dict keyed by slot: duplicate keys in one push dedup to the
+            # LAST update (install order), which the row layout requires —
+            # two concurrent row DMAs to one slot are a data race
+            # (rowtable.scatter_rows) — and the column path's sequential
+            # scatter resolved the same way.
+            by_slot: Dict[int, tuple] = {}
             for u in updates:
                 try:
                     slot, _ = self._resolve_slot(u.key, now)
@@ -1297,17 +1310,23 @@ class TickEngine:
                     continue  # table full; drop (the next broadcast retries)
                 self._last_access[slot] = self._tick_count
                 self._pending.discard(slot)  # device write happens right here
-                rows.append(
-                    (slot, u.algorithm, u.status.limit, u.status.remaining,
-                     u.status.status, u.duration, u.status.reset_time, 1)
+                by_slot[slot] = (
+                    slot, u.algorithm, u.status.limit, u.status.remaining,
+                    u.status.status, u.duration, u.status.reset_time, 1,
                 )
-            if not rows:
+            if not by_slot:
                 return
-            cols = np.zeros((8, pad_pow2(len(rows))), np.int64)
-            cols[:, : len(rows)] = np.array(rows, np.int64).T
-            self.state = self._install(
-                self.state, jnp.asarray(cols), jnp.int64(now)
-            )
+            rows = list(by_slot.values())
+            # Width-chunked like load_items: the row layout stages the
+            # batch in VMEM, so one huge push must not compile one huge
+            # program.
+            for start in range(0, len(rows), RESTORE_CHUNK):
+                part = rows[start : start + RESTORE_CHUNK]
+                cols = np.zeros((8, pad_pow2(len(part))), np.int64)
+                cols[:, : len(part)] = np.array(part, np.int64).T
+                self.state = self._install(
+                    self.state, jnp.asarray(cols), jnp.int64(now)
+                )
 
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
@@ -1339,7 +1358,12 @@ class TickEngine:
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
             self._tick_count += 1  # see install_globals: unblock LRU reclaim
-            live = [it for it in items if it["expire_at"] >= now]
+            # Dedup by key (last wins): duplicate keys would resolve to one
+            # slot and race in the row layout's scatter (see install_globals).
+            live_by_key = {
+                it["key"]: it for it in items if it["expire_at"] >= now
+            }
+            live = list(live_by_key.values())
             if not live:
                 return
             shortfall = len(self.slots) + len(live) - self.capacity
@@ -1351,11 +1375,17 @@ class TickEngine:
             ok = np.flatnonzero(slots >= 0)  # full table: drop the tail
             if len(ok) == 0:
                 return
-            ints, floats = pack_restore_matrix(live, ok, slots)
             self._last_access[slots[ok]] = self._tick_count
-            self.state = self._restore(
-                self.state, jnp.asarray(ints), jnp.asarray(floats)
-            )
+            # Chunked like evict_chunked: one restore per RESTORE_CHUNK
+            # keeps the compiled width bounded — the row layout stages
+            # the batch in VMEM (512 B/row), so a multi-million-item
+            # snapshot in one call would not even compile.
+            for start in range(0, len(ok), RESTORE_CHUNK):
+                part = ok[start : start + RESTORE_CHUNK]
+                ints, floats = pack_restore_matrix(live, part, slots)
+                self.state = self._restore(
+                    self.state, jnp.asarray(ints), jnp.asarray(floats)
+                )
 
     def cache_size(self) -> int:
         return len(self.slots)

@@ -45,7 +45,6 @@ from gubernator_tpu.ops.engine import (
     make_install_fn,
     make_restore_fn,
     make_tick_fn,
-    pack_request_col,
     pack_restore_matrix,
     pad_pow2,
     resolve_gregorian,
@@ -246,55 +245,121 @@ class MeshTickEngine:
         out: List[Optional[RateLimitResponse]],
         now: int,
     ) -> List[int]:
-        """Run one device tick over as many of ``todo`` as fit; return spill."""
+        """Run one device tick over as many of ``todo`` as fit; return spill.
+
+        Packing is column-vectorized like TickEngine.build_batch: one
+        Python pass collects request fields, keys resolve in one native
+        batch per shard (reclaim + retry on a full shard), and every
+        request-matrix row is one fancy-indexed numpy write — the scalar
+        per-request ``pack_request_col`` loop was the multi-chip host
+        bottleneck."""
         b = self.max_batch
-        m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
-        m[:, REQ_ROW_INDEX["slot"], :] = self.local_capacity
-        fill = np.zeros(self.n_shards, np.int32)  # next free column per shard
-        where = {}  # request index → (shard, pos)
-        spill: List[int] = []
+        R = REQ_ROW_INDEX
         self._tick_count += 1
+
+        # One attribute pass: gregorian, key, shard.
+        idx: List[int] = []
+        keys: List[str] = []
+        shard_l: List[int] = []
+        greg_e: List[int] = []
+        greg_d: List[int] = []
         for i in todo:
             r = requests[i]
             try:
-                greg_exp, greg_dur = resolve_gregorian(r, now)
+                ge, gd = resolve_gregorian(r, now)
             except timeutil.GregorianError as e:
                 out[i] = RateLimitResponse(error=str(e))
                 continue
-            key = r.hash_key()
-            shard = self._shard_of(key)
-            pos = int(fill[shard])
-            if pos >= b:
-                spill.append(i)
-                continue
-            g, known = self._resolve(key, shard, now)
-            if g is None:
-                spill.append(i)
-                continue
-            fill[shard] += 1
-            pack_request_col(
-                m[shard], pos, r,
-                slot=g - shard * self.local_capacity,
-                known=known, now=now, greg_exp=greg_exp, greg_dur=greg_dur,
-            )
-            where[i] = (shard, pos)
+            k = r.hash_key()
+            idx.append(i)
+            keys.append(k)
+            shard_l.append(self._shard_of(k))
+            greg_e.append(ge)
+            greg_d.append(gd)
+        if not idx:
+            return []
+        n = len(idx)
+        shards = np.asarray(shard_l, np.int64)
 
-        if where:
-            reqs_dev = jax.device_put(
-                m, NamedSharding(self.mesh, P("shard", None, None))
+        # Resolve keys shard by shard in one native batch each.
+        slots = np.full(n, -1, np.int64)  # local slot within the shard
+        known = np.zeros(n, np.uint8)
+        pos = np.full(n, -1, np.int64)
+        for s in np.unique(shards):
+            sel = np.flatnonzero(shards == s)
+            kb = [keys[j].encode() for j in sel]
+            sm = self.slots[s]
+            sl, kn = sm.resolve_batch(kb)
+            if (sl < 0).any():
+                # Stamp already-resolved rows live before reclaiming
+                # (see TickEngine.build_batch: an unstamped reclaim could
+                # hand a just-resolved slot to the retried keys).
+                okm = sl >= 0
+                g = s * self.local_capacity + sl[okm]
+                self._last_access[g] = self._tick_count
+                self._pending.update(g[kn[okm] == 0].tolist())
+                self._reclaim(s, now)
+                retry = np.flatnonzero(sl < 0)
+                s2, k2 = sm.resolve_batch([kb[t] for t in retry])
+                sl[retry] = s2
+                kn[retry] = k2
+            slots[sel] = sl
+            known[sel] = kn
+            # Arrival-order position within the shard, assigned only to
+            # requests whose key resolved: a full shard's failures must
+            # not burn block columns that later resolvable requests need
+            # (they spill; resolved overflow past the block width spills
+            # too and retries with its slot already assigned).
+            rs = sel[sl >= 0]
+            pos[rs] = np.arange(len(rs))
+
+        ok = (slots >= 0) & (pos >= 0) & (pos < b)
+        g_ok = shards[ok] * self.local_capacity + slots[ok]
+        self._last_access[g_ok] = self._tick_count
+        self._pending.update(g_ok[known[ok] == 0].tolist())
+        spill = [idx[j] for j in np.flatnonzero(~ok)]
+        sel = np.flatnonzero(ok)
+        if len(sel) == 0:
+            return spill
+
+        m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
+        m[:, R["slot"], :] = self.local_capacity
+        sh, ps = shards[sel], pos[sel]
+        hits, limit, duration, algo, behav, created, burst = zip(*(
+            (r.hits, r.limit, r.duration, int(r.algorithm), int(r.behavior),
+             r.created_at if r.created_at is not None else now, r.burst)
+            for r in (requests[idx[j]] for j in sel)
+        ))
+        m[sh, R["slot"], ps] = slots[sel]
+        m[sh, R["known"], ps] = known[sel]
+        m[sh, R["hits"], ps] = hits
+        m[sh, R["limit"], ps] = limit
+        m[sh, R["duration"], ps] = duration
+        m[sh, R["algorithm"], ps] = algo
+        m[sh, R["behavior"], ps] = behav
+        m[sh, R["created_at"], ps] = created
+        m[sh, R["burst"], ps] = burst
+        m[sh, R["greg_exp"], ps] = np.asarray(greg_e, np.int64)[sel]
+        m[sh, R["greg_dur"], ps] = np.asarray(greg_d, np.int64)[sel]
+        m[sh, R["valid"], ps] = 1
+
+        reqs_dev = jax.device_put(
+            m, NamedSharding(self.mesh, P("shard", None, None))
+        )
+        self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(now))
+        self._pending.clear()
+        rm = np.asarray(resp)  # (n_shards, 5, B)
+        self.metric_over_limit += int(rm[sh, 4, ps].sum())
+        status, limit_o, remaining, reset = (
+            rm[sh, r, ps].tolist() for r in range(4)
+        )
+        for t, j in enumerate(sel):
+            out[idx[j]] = RateLimitResponse(
+                status=status[t],
+                limit=limit_o[t],
+                remaining=remaining[t],
+                reset_time=reset[t],
             )
-            self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(now))
-            self._pending.clear()
-            rm = np.asarray(resp)  # (n_shards, 5, B)
-            for i, (shard, pos) in where.items():
-                status, limit, remaining, reset, over = rm[shard, :, pos]
-                self.metric_over_limit += int(over)
-                out[i] = RateLimitResponse(
-                    status=int(status),
-                    limit=int(limit),
-                    remaining=int(remaining),
-                    reset_time=int(reset),
-                )
         return spill
 
     def install_globals(

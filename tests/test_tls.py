@@ -205,13 +205,18 @@ async def test_mtls_cluster_forwarding(ca_files):
     d2.set_peers(peers)
 
     # Find a key d1 does NOT own so the request forwards over mTLS.
+    # set_peers applies asynchronously — poll until the picker is live.
     key = None
-    for i in range(64):
-        cand = f"k{i}"
-        peer = d1.instance.get_peer(f"test_tls_{cand}")
-        if peer is not None and not peer.info.is_owner:
-            key = cand
+    for _ in range(100):
+        for i in range(64):
+            cand = f"k{i}"
+            peer = d1.instance.get_peer(f"test_tls_{cand}")
+            if peer is not None and not peer.info.is_owner:
+                key = cand
+                break
+        if key is not None:
             break
+        await asyncio.sleep(0.05)
     assert key is not None
 
     client = DaemonClient(
