@@ -71,7 +71,7 @@ def rung_kernel():
 
     rng = np.random.default_rng(0)
     m = np.zeros((len(REQ_ROWS), batch), np.int64)
-    m[rows["slot"]] = rng.permutation(capacity)[:batch]
+    m[rows["slot"]] = np.sort(rng.permutation(capacity)[:batch])
     m[rows["known"]] = 1
     m[rows["hits"]] = 1
     m[rows["limit"]] = 1_000_000
@@ -86,7 +86,7 @@ def rung_kernel():
     from gubernator_tpu.ops.rowtable import RowState
 
     layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
-    tick = make_tick_fn(capacity, layout=layout)
+    tick = make_tick_fn(capacity, layout=layout, sorted_input=True)
     zeros = RowState.zeros if layout == "row" else BucketState.zeros
     state = jax.tree.map(jnp.asarray, zeros(capacity))
     packed = jnp.asarray(m)

@@ -295,9 +295,6 @@ def _apply_merged_followers(
     rounds).
     """
     b = reqs.slot.shape[0]
-    TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
-    UNDER = jnp.int32(Status.UNDER_LIMIT)
-    OVER = jnp.int32(Status.OVER_LIMIT)
     NO_MERGE = jnp.int32(
         Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN
     )
@@ -314,7 +311,6 @@ def _apply_merged_followers(
         & (reqs.burst == hd(reqs.burst))
         & (reqs.algorithm == hd(reqs.algorithm))
     )
-    is_tok = reqs.algorithm == TOKEN
     # Followers must take the exists path (known & in_use & now<=expire);
     # heads are exempt from the known check (their round-0 transition
     # handles the new-item case and leaves in_use set).
@@ -336,11 +332,25 @@ def _apply_merged_followers(
     # Post-head state of the group's slot, read straight from the heads'
     # transition output (identical to a table gather after the head
     # scatter, minus the gather).
-    R0 = hd(new_g.remaining)
-    F0 = hd(new_g.remaining_f)
+    return _merged_formulas(
+        new_g, resp, reqs, now, rank, group_size, ok, group_ok,
+        hd(new_g.remaining), hd(new_g.remaining_f),
+        hd(new_g.status), hd(new_g.expire_at),
+    )
+
+
+def _merged_formulas(new_g, resp, reqs, now, rank, group_size, ok, group_ok,
+                     R0, F0, S0, E):
+    """The closed-form follower fold shared by the gather-based (unsorted)
+    and scan-based (sorted-input) merge paths; see
+    :func:`_apply_merged_followers` for the math.  ``R0/F0/S0/E`` are the
+    group head's post-transition remaining/remaining_f/status/expire_at
+    broadcast to every member."""
+    TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+    is_tok = reqs.algorithm == TOKEN
     N0 = F0.astype(jnp.int64)  # Go float64→int64 truncation
-    S0 = hd(new_g.status)
-    E = hd(new_g.expire_at)
     alive = now <= E
 
     merged = group_ok & ok & alive & (rank > 0)
@@ -405,8 +415,95 @@ def _apply_merged_followers(
     return rows, resp, merged
 
 
+def _seg_propagate(is_start, vals):
+    """Broadcast each segment head's values to every member (segmented
+    inclusive scan; the classic (flag, value) combine — associative)."""
+    def combine(a, b):
+        fa, va = a[0], a[1:]
+        fb, vb = b[0], b[1:]
+        return (fa | fb,) + tuple(
+            jnp.where(fb, y, x) for x, y in zip(va, vb)
+        )
+
+    out = lax.associative_scan(combine, (is_start,) + tuple(vals))
+    return out[1:]
+
+
+def _seg_any(is_start, bad):
+    """Per-row "any bad member in my segment" without scatters: a forward
+    segmented OR covers [start..i], a backward one covers [i..end]."""
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va | vb)
+
+    fwd = lax.associative_scan(combine, (is_start, bad))[1]
+    last = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
+    bwd = lax.associative_scan(
+        combine, (last[::-1], bad[::-1])
+    )[1][::-1]
+    return fwd | bwd
+
+
+def _apply_merged_followers_sorted(
+    new_g: BucketState,
+    resp: RespBatch,
+    reqs: ReqBatch,
+    now: jnp.ndarray,
+    rank: jnp.ndarray,
+    group_size: jnp.ndarray,
+    is_start: jnp.ndarray,
+):
+    """Scan-based merge fold for slot-sorted batches.
+
+    Same semantics as :func:`_apply_merged_followers`, but with the batch
+    sorted by slot every segment is a contiguous run, so the head-value
+    broadcasts become one segmented scan and the group-wide "every member
+    mergeable" check becomes neighbor comparisons + segmented ORs — no
+    B-sized gathers or scatters at all (8-byte gathers/scatters measured
+    ~0.5/3.4 ms per 32k op on v5e; scans are tens of µs)."""
+    NO_MERGE = jnp.int32(
+        Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN
+    )
+
+    def eq_prev(a):
+        return jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), a[1:] == a[:-1]]
+        )
+
+    # "Equals its predecessor" chains to "equals its head" within a
+    # contiguous segment, so the group-wide ALL over this row predicate is
+    # exactly the unsorted path's same_as_head quantifier.
+    same_as_prev = is_start | (
+        eq_prev(reqs.hits)
+        & eq_prev(reqs.limit)
+        & eq_prev(reqs.duration)
+        & eq_prev(reqs.behavior)
+        & eq_prev(reqs.created_at)
+        & eq_prev(reqs.burst)
+        & eq_prev(reqs.algorithm)
+    )
+    ok = (
+        reqs.valid
+        & same_as_prev
+        & (reqs.hits > 0)
+        & ((reqs.behavior & NO_MERGE) == 0)
+        & (reqs.known | (rank == 0))
+    )
+    group_ok = ~_seg_any(is_start, reqs.valid & ~ok)
+
+    R0, F0, S0, E = _seg_propagate(
+        is_start,
+        (new_g.remaining, new_g.remaining_f, new_g.status, new_g.expire_at),
+    )
+    return _merged_formulas(
+        new_g, resp, reqs, now, rank, group_size, ok, group_ok,
+        R0, F0, S0, E,
+    )
+
+
 def make_tick_fn(capacity: int, merge_uniform: bool = True,
-                 layout: str = "columns"):
+                 layout: str = "columns", sorted_input: bool = False):
     """Build the jittable tick: (state, reqs, now) → (state, responses).
 
     Pure function of its inputs (no clocks, no host state) so the driver can
@@ -460,41 +557,81 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
         new_g, r_out = bucket_transition(now, gathered, reqs)
 
         if merge_uniform:
-            # The duplicate-group machinery (segmented sizes/head gathers,
-            # closed-form follower fold) costs ~2x the rest of a tick in
-            # B-sized scatter ops — and an all-unique batch needs none of
-            # it.  Sort once to detect duplicates, then lax.cond so unique
-            # batches skip straight to "every row is its own head".
-            sort_key = jnp.where(reqs.valid, reqs.slot, capacity).astype(
-                jnp.int32
-            )
-            order = jnp.argsort(sort_key, stable=True)
-            sorted_key = sort_key[order]
-            has_dups = jnp.any(
-                (sorted_key[1:] == sorted_key[:-1])
-                & (sorted_key[1:] < jnp.int32(capacity))
-            )
-
-            def dup_branch(_):
-                rank, group_size, head_idx, seg_id = _segments_from_sorted(
-                    sorted_key, order
-                )
-                heads = reqs.valid & (rank == 0)
-                resp = jax.tree.map(
-                    lambda old, new: jnp.where(heads, new, old), resp0, r_out
-                )
-                rows, resp, merged = _apply_merged_followers(
-                    new_g, resp, reqs, now,
-                    rank, group_size, head_idx, seg_id,
-                )
-                return rows, resp, merged, rank
-
+            # The duplicate-group machinery costs ~2x the rest of a tick
+            # — and an all-unique batch needs none of it.  Detect
+            # duplicates once, then lax.cond so unique batches skip
+            # straight to "every row is its own head".
             def unique_branch(_):
                 resp = jax.tree.map(
                     lambda old, new: jnp.where(reqs.valid, new, old),
                     resp0, r_out,
                 )
                 return new_g, resp, reqs.valid, jnp.zeros(b, jnp.int32)
+
+            if sorted_input:
+                # Contract: the host packed the batch sorted by slot with
+                # invalid/padding rows (slot=capacity) at the end, so
+                # every slot group is a contiguous run and all segment
+                # math is neighbor compares + scans — no device sort, no
+                # B-sized gathers/scatters anywhere in the merge path.
+                sorted_key = jnp.where(
+                    reqs.valid, reqs.slot, capacity
+                ).astype(jnp.int32)
+                is_start = jnp.concatenate(
+                    [jnp.ones((1,), jnp.bool_),
+                     sorted_key[1:] != sorted_key[:-1]]
+                )
+                has_dups = jnp.any((~is_start[1:]) & reqs.valid[1:])
+
+                def dup_branch(_):
+                    idx = jnp.arange(b, dtype=jnp.int32)
+                    seg_start = lax.associative_scan(
+                        jnp.maximum, jnp.where(is_start, idx, 0)
+                    )
+                    rank = idx - seg_start
+                    nxt = jnp.where(is_start, idx, jnp.int32(b))
+                    sfx = lax.associative_scan(
+                        jnp.minimum, nxt[::-1]
+                    )[::-1]
+                    seg_end = jnp.concatenate(
+                        [sfx[1:], jnp.full((1,), b, jnp.int32)]
+                    )
+                    group_size = seg_end - seg_start
+                    heads = reqs.valid & (rank == 0)
+                    resp = jax.tree.map(
+                        lambda old, new: jnp.where(heads, new, old),
+                        resp0, r_out,
+                    )
+                    rows, resp, merged = _apply_merged_followers_sorted(
+                        new_g, resp, reqs, now, rank, group_size, is_start
+                    )
+                    return rows, resp, merged, rank
+
+            else:
+                sort_key = jnp.where(
+                    reqs.valid, reqs.slot, capacity
+                ).astype(jnp.int32)
+                order = jnp.argsort(sort_key, stable=True)
+                sorted_key = sort_key[order]
+                has_dups = jnp.any(
+                    (sorted_key[1:] == sorted_key[:-1])
+                    & (sorted_key[1:] < jnp.int32(capacity))
+                )
+
+                def dup_branch(_):
+                    rank, group_size, head_idx, seg_id = (
+                        _segments_from_sorted(sorted_key, order)
+                    )
+                    heads = reqs.valid & (rank == 0)
+                    resp = jax.tree.map(
+                        lambda old, new: jnp.where(heads, new, old),
+                        resp0, r_out,
+                    )
+                    rows, resp, merged = _apply_merged_followers(
+                        new_g, resp, reqs, now,
+                        rank, group_size, head_idx, seg_id,
+                    )
+                    return rows, resp, merged, rank
 
             rows, resp, merged, rank = lax.cond(
                 has_dups, dup_branch, unique_branch, None
@@ -723,11 +860,15 @@ def make_evict_fn(layout: str = "columns"):
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_tick(capacity: int, layout: str = "columns"):
+def _jitted_tick(capacity: int, layout: str = "columns",
+                 sorted_input: bool = False):
     """Shared jitted tick per capacity: engines pass state explicitly, so an
     in-process multi-daemon cluster (the reference's test topology,
     cluster/cluster.go) compiles the kernel once, not once per daemon."""
-    return jax.jit(make_tick_fn(capacity, layout=layout), donate_argnums=(0,))
+    return jax.jit(
+        make_tick_fn(capacity, layout=layout, sorted_input=sorted_input),
+        donate_argnums=(0,),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -954,7 +1095,8 @@ class TickEngine:
         zeros, _, _ = _layout_ops(self.layout)
         with jax.default_device(self.device):
             self.state = jax.tree.map(jnp.asarray, zeros(self.capacity))
-        self._tick = _jitted_tick(self.capacity, self.layout)
+        self._tick = _jitted_tick(self.capacity, self.layout,
+                                  sorted_input=True)
         # Tick widths: one narrow program for typical service batches
         # (≤ the reference's 1000-item batch limit) plus the full width.
         # Singleton for small engines so test clusters don't pay an extra
@@ -1097,7 +1239,7 @@ class TickEngine:
         else:
             sel = np.arange(n, dtype=np.int64)
         if len(sel) == 0:
-            return m, n, errors
+            return m, n, errors, np.arange(n, dtype=np.int64)
 
         # One native call resolves every key to a slot (the reference does a
         # per-key map lookup inside each worker goroutine; here it's a batch
@@ -1151,7 +1293,17 @@ class TickEngine:
         m[R["created_at"], sel] = created
         m[R["burst"], sel] = burst
         m[R["valid"], sel] = 1
-        return m, n, errors
+        # Sort the batch by slot (stable: same-slot requests keep arrival
+        # order, the duplicate-sequencing contract).  The tick's
+        # sorted-input path then does all segment math with neighbor
+        # compares + scans — a host argsort here is ~100x cheaper than
+        # the device-side gathers/scatters it replaces.  Error rows
+        # (slot=capacity) sort to the end with the padding.
+        order = np.argsort(m[R["slot"], :n], kind="stable")
+        m[:, :n] = m[:, :n][:, order]
+        inv = np.empty(n, np.int64)
+        inv[order] = np.arange(n)
+        return m, n, errors, inv
 
     def _read_through(self, requests, sel, slots, known, miss) -> None:
         """Store.Get for cache misses (algorithms.go:45-51): install the
@@ -1203,7 +1355,7 @@ class TickEngine:
             for chunk_start in range(0, len(requests), self.max_batch):
                 chunk = requests[chunk_start : chunk_start + self.max_batch]
                 self._tick_count += 1
-                packed, n, errors = self.build_batch(chunk, now)
+                packed, n, errors, inv = self.build_batch(chunk, now)
                 # Named range in XProf captures (utils/tracing.py): device
                 # tick vs host packing shows up separated in the profile.
                 with tracing.profile_annotation("guber.tick"):
@@ -1211,10 +1363,13 @@ class TickEngine:
                         self.state, jnp.asarray(packed), jnp.int64(now)
                     )
                 self._pending.clear()
-                rm = np.asarray(resp)  # one D2H: (5, B) int64
+                rm = np.asarray(resp)[:, :n][:, inv]  # one D2H, unsorted
                 self.metric_over_limit += int(rm[4, :n].sum())
                 if self.store is not None:
-                    self._write_through(chunk, packed, n, errors)
+                    self._write_through(
+                        chunk, packed[REQ_ROW_INDEX["slot"], :n][inv],
+                        n, errors,
+                    )
                 # tolist() converts each column to Python ints in one C
                 # call — per-element np-scalar int() was a top host cost.
                 status, limit, remaining, reset = (
@@ -1234,14 +1389,14 @@ class TickEngine:
         return out
 
     def _write_through(
-        self, requests: Sequence[RateLimitRequest], packed: np.ndarray,
+        self, requests: Sequence[RateLimitRequest], slots: np.ndarray,
         n: int, errors: Dict[int, str],
     ) -> None:
         """Store.OnChange with each touched slot's post-tick state
         (write-through, algorithms.go:149-153).  A slot cleared by the tick
         (RESET_REMAINING removal) maps to Store.remove instead, matching the
-        reference's remove-on-reset (algorithms.go:78-90)."""
-        slots = packed[REQ_ROW_INDEX["slot"], :n]
+        reference's remove-on-reset (algorithms.go:78-90).  ``slots`` is in
+        request order (process() un-permutes the sorted batch)."""
         # Pad to a power of two so this per-tick hot path compiles a handful
         # of widths, not one per batch size; padding slots aim out of range
         # (zero-fill on columns, guard-row garbage on rows) and rows past n
