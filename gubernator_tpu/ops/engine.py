@@ -164,6 +164,58 @@ REQ_ROWS = (
 REQ_ROW_INDEX = {name: i for i, name in enumerate(REQ_ROWS)}
 
 
+def pack_request_matrix(
+    m: np.ndarray,
+    sel,
+    requests,
+    slots,
+    known,
+    now: int,
+    *,
+    nodes=None,
+    behav=None,
+    greg=None,
+) -> None:
+    """Vectorized fill of the packed request matrix: one attribute pass
+    over ``requests`` plus one fancy-indexed numpy write per row.  Shared
+    by all three engines (single-chip build_batch, mesh shards, GLOBAL
+    mesh) so the REQ_ROWS layout has exactly one packing implementation.
+
+    ``m`` is (len(REQ_ROWS), B), or (N, len(REQ_ROWS), B) with ``nodes``
+    giving the leading-axis index per request.  ``behav`` optionally
+    passes precomputed int behaviors (IntFlag conversion is a measured
+    host hotspot).  ``greg`` is (greg_exp, greg_dir) per request, or None
+    when the caller already wrote those rows."""
+    R = REQ_ROW_INDEX
+
+    def put(row, vals):
+        if nodes is None:
+            m[R[row], sel] = vals
+        else:
+            m[nodes, R[row], sel] = vals
+
+    if behav is None:
+        behav = [int(r.behavior) for r in requests]
+    hits, limit, duration, algo, created, burst = zip(*(
+        (r.hits, r.limit, r.duration, int(r.algorithm),
+         r.created_at if r.created_at is not None else now, r.burst)
+        for r in requests
+    ))
+    put("slot", slots)
+    put("known", known)
+    put("hits", hits)
+    put("limit", limit)
+    put("duration", duration)
+    put("algorithm", algo)
+    put("behavior", behav)
+    put("created_at", created)
+    put("burst", burst)
+    if greg is not None:
+        put("greg_exp", greg[0])
+        put("greg_dur", greg[1])
+    put("valid", 1)
+
+
 def resolve_gregorian(r: "RateLimitRequest", now: int) -> tuple[int, int]:
     """Host-side Gregorian resolution for one request: (greg_exp, greg_dur).
 
@@ -178,33 +230,6 @@ def resolve_gregorian(r: "RateLimitRequest", now: int) -> tuple[int, int]:
         timeutil.gregorian_expiration(now, r.duration),
         timeutil.gregorian_duration(now, r.duration),
     )
-
-
-def pack_request_col(
-    m: np.ndarray,
-    col: int,
-    r: "RateLimitRequest",
-    *,
-    slot: int,
-    known: bool,
-    now: int,
-    greg_exp: int = 0,
-    greg_dur: int = 0,
-) -> None:
-    """Write one request into column ``col`` of a (len(REQ_ROWS), B) matrix."""
-    R = REQ_ROW_INDEX
-    m[R["slot"], col] = slot
-    m[R["known"], col] = known
-    m[R["hits"], col] = r.hits
-    m[R["limit"], col] = r.limit
-    m[R["duration"], col] = r.duration
-    m[R["algorithm"], col] = int(r.algorithm)
-    m[R["behavior"], col] = int(r.behavior)
-    m[R["created_at"], col] = r.created_at if r.created_at is not None else now
-    m[R["burst"], col] = r.burst
-    m[R["greg_exp"], col] = greg_exp
-    m[R["greg_dur"], col] = greg_dur
-    m[R["valid"], col] = 1
 
 
 def unpack_reqs(packed: jnp.ndarray) -> ReqBatch:
@@ -1276,23 +1301,12 @@ class TickEngine:
             self._read_through(requests, sel, slots, known, miss)
 
         # Column-wise packing: one pass over the requests collecting every
-        # field (attribute access dominates; six separate passes paid it
-        # six times), then one vectorized write per row.
-        m[R["slot"], sel] = slots
-        m[R["known"], sel] = known
-        hits, limit, duration, algo, created, burst = zip(*(
-            (r.hits, r.limit, r.duration, int(r.algorithm),
-             r.created_at if r.created_at is not None else now, r.burst)
-            for r in (requests[i] for i in sel)
-        ))
-        m[R["hits"], sel] = hits
-        m[R["limit"], sel] = limit
-        m[R["duration"], sel] = duration
-        m[R["algorithm"], sel] = algo
-        m[R["behavior"], sel] = [behav[i] for i in sel]
-        m[R["created_at"], sel] = created
-        m[R["burst"], sel] = burst
-        m[R["valid"], sel] = 1
+        # field, then one vectorized write per row (greg rows were written
+        # above).
+        pack_request_matrix(
+            m, sel, [requests[i] for i in sel], slots, known, now,
+            behav=[behav[i] for i in sel],
+        )
         # Sort the batch by slot (stable: same-slot requests keep arrival
         # order, the duplicate-sequencing contract).  The tick's
         # sorted-input path then does all segment math with neighbor

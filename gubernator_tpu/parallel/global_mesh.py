@@ -73,9 +73,9 @@ from gubernator_tpu.ops.buckets import (
 from gubernator_tpu.ops.engine import (
     REQ_ROWS,
     REQ_ROW_INDEX,
+    pack_request_matrix,
     _slot_segments,
     make_slot_map,
-    pack_request_col,
     pack_resp,
     pad_pow2,
     resolve_gregorian,
@@ -509,18 +509,23 @@ class MeshGlobalEngine:
         return out  # type: ignore[return-value]
 
     def _tick_once(self, blocks, todo, out, now):
+        """Column-vectorized like TickEngine.build_batch: one attribute
+        pass per node block, then one fancy-indexed numpy write per
+        request-matrix row (the scalar pack_request_col loop was the
+        GLOBAL-mesh host bottleneck)."""
         b = self.max_batch
         m = np.zeros((self.n_nodes, len(REQ_ROWS), b), np.int64)
-        m[:, REQ_ROW_INDEX["slot"], :] = self.capacity
+        R = REQ_ROW_INDEX
+        m[:, R["slot"], :] = self.capacity
         self._tick_count += 1
         spill = [[] for _ in range(self.n_nodes)]
-        where = {}
+        packed: List[tuple] = []  # (d, col, j, request, slot, known, ge, gd)
         for d, idxs in enumerate(todo):
             col = 0
             for j in idxs:
                 r = blocks[d][j]
                 try:
-                    greg_exp, greg_dur = resolve_gregorian(r, now)
+                    ge, gd = resolve_gregorian(r, now)
                 except timeutil.GregorianError as e:
                     out[d][j] = RateLimitResponse(error=str(e))
                     continue
@@ -531,13 +536,17 @@ class MeshGlobalEngine:
                 if slot is None:
                     spill[d].append(j)
                     continue
-                pack_request_col(
-                    m[d], col, r, slot=slot, known=known, now=now,
-                    greg_exp=greg_exp, greg_dur=greg_dur,
-                )
-                where[(d, col)] = j
+                packed.append((d, col, j, r, slot, known, ge, gd))
                 col += 1
-        if where:
+        if packed:
+            dd = np.fromiter((p[0] for p in packed), np.int64, len(packed))
+            cc = np.fromiter((p[1] for p in packed), np.int64, len(packed))
+            pack_request_matrix(
+                m, cc, [p[3] for p in packed],
+                [p[4] for p in packed], [p[5] for p in packed], now,
+                nodes=dd,
+                greg=([p[6] for p in packed], [p[7] for p in packed]),
+            )
             self.state, self.aux, self.accum, resp = self._proc(
                 self.state, self.aux, self.accum,
                 jax.device_put(m, self._req_sharding),
@@ -545,11 +554,13 @@ class MeshGlobalEngine:
             )
             self._pending.clear()
             rm = np.asarray(resp)  # (n_nodes, 5, B)
-            for (d, col), j in where.items():
-                status, limit, remaining, reset, _ = rm[d, :, col]
-                out[d][j] = RateLimitResponse(
-                    status=int(status), limit=int(limit),
-                    remaining=int(remaining), reset_time=int(reset),
+            status, limit_o, remaining, reset = (
+                rm[dd, r, cc].tolist() for r in range(4)
+            )
+            for t, p in enumerate(packed):
+                out[p[0]][p[2]] = RateLimitResponse(
+                    status=status[t], limit=limit_o[t],
+                    remaining=remaining[t], reset_time=reset[t],
                 )
         return spill
 

@@ -45,6 +45,7 @@ from gubernator_tpu.ops.engine import (
     make_install_fn,
     make_restore_fn,
     make_tick_fn,
+    pack_request_matrix,
     pack_restore_matrix,
     pad_pow2,
     resolve_gregorian,
@@ -325,23 +326,12 @@ class MeshTickEngine:
         m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
         m[:, R["slot"], :] = self.local_capacity
         sh, ps = shards[sel], pos[sel]
-        hits, limit, duration, algo, behav, created, burst = zip(*(
-            (r.hits, r.limit, r.duration, int(r.algorithm), int(r.behavior),
-             r.created_at if r.created_at is not None else now, r.burst)
-            for r in (requests[idx[j]] for j in sel)
-        ))
-        m[sh, R["slot"], ps] = slots[sel]
-        m[sh, R["known"], ps] = known[sel]
-        m[sh, R["hits"], ps] = hits
-        m[sh, R["limit"], ps] = limit
-        m[sh, R["duration"], ps] = duration
-        m[sh, R["algorithm"], ps] = algo
-        m[sh, R["behavior"], ps] = behav
-        m[sh, R["created_at"], ps] = created
-        m[sh, R["burst"], ps] = burst
-        m[sh, R["greg_exp"], ps] = np.asarray(greg_e, np.int64)[sel]
-        m[sh, R["greg_dur"], ps] = np.asarray(greg_d, np.int64)[sel]
-        m[sh, R["valid"], ps] = 1
+        pack_request_matrix(
+            m, ps, [requests[idx[j]] for j in sel], slots[sel], known[sel],
+            now, nodes=sh,
+            greg=(np.asarray(greg_e, np.int64)[sel],
+                  np.asarray(greg_d, np.int64)[sel]),
+        )
 
         reqs_dev = jax.device_put(
             m, NamedSharding(self.mesh, P("shard", None, None))
