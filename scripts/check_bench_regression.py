@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""CI benchmark regression gate: compare two bench.py result files.
+
+The reference fails pull requests at >200% slowdown vs master via
+benchmark-action (/root/reference/.github/workflows/on-pull-request.yml,
+alert-threshold "200%"); this is the same gate over the BENCH_r*.json
+ladder:
+
+    python scripts/check_bench_regression.py BENCH_r01.json BENCH_r02.json
+
+Exits 1 if the headline metric or any shared throughput rung regressed
+past the threshold (default 2.0x, override with --threshold).  Rungs
+present in only one file are reported but don't gate (the ladder grows
+between rounds).
+"""
+
+import argparse
+import json
+import sys
+
+RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
+
+
+def load_bench(path):
+    """Accept either bench.py's raw JSON line or the driver's BENCH_r*.json
+    wrapper (which captures that line inside its "tail" field)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "value" in doc:
+        return doc
+    for line in reversed(doc.get("tail", "").splitlines()):
+        if line.startswith("{") and '"metric"' in line:
+            return json.loads(line)
+    raise SystemExit(f"{path}: no bench result found")
+
+
+def rates(doc):
+    out = {"headline": float(doc["value"])}
+    for rung in doc.get("ladder", []):
+        for k in RATE_KEYS:
+            if rung.get(k):
+                out[rung["rung"]] = float(rung[k])
+                break
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--threshold", type=float, default=2.0,
+                    help="fail when baseline/candidate exceeds this")
+    args = ap.parse_args()
+
+    base = rates(load_bench(args.baseline))
+    cand = rates(load_bench(args.candidate))
+
+    failed = False
+    for name in sorted(set(base) | set(cand)):
+        b, c = base.get(name), cand.get(name)
+        if b is None or c is None:
+            print(f"  {name}: only in "
+                  f"{'candidate' if b is None else 'baseline'} — not gated")
+            continue
+        if c <= 0:
+            print(f"  {name}: candidate rate is 0 — FAIL")
+            failed = True
+            continue
+        slowdown = b / c
+        mark = "FAIL" if slowdown > args.threshold else "ok"
+        if slowdown > args.threshold:
+            failed = True
+        print(f"  {name}: {b:,.0f} -> {c:,.0f} "
+              f"({1 / slowdown:.2f}x, {mark})")
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()
