@@ -184,8 +184,10 @@ def pack_request_matrix(
     ``m`` is (len(REQ_ROWS), B), or (N, len(REQ_ROWS), B) with ``nodes``
     giving the leading-axis index per request.  ``behav`` optionally
     passes precomputed int behaviors (IntFlag conversion is a measured
-    host hotspot).  ``greg`` is (greg_exp, greg_dir) per request, or None
+    host hotspot).  ``greg`` is (greg_exp, greg_dur) per request, or None
     when the caller already wrote those rows."""
+    if len(requests) == 0:
+        return
     R = REQ_ROW_INDEX
 
     def put(row, vals):

@@ -61,6 +61,11 @@ ROW_W = 128     # int32 words per row (Mosaic lane-alignment minimum)
 DMA_RING = 32   # in-flight DMA ring depth
 DMA_UNROLL = 4  # DMAs issued per scalar-loop step
 
+# The kernels stage the whole (B, ROW_W) batch block in VMEM; Mosaic's
+# default scoped-vmem budget rejects a 64k-row tick (gather out-block +
+# scatter in-block, 32 MB each), so raise it — v5e has 128 MB of VMEM.
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
 
 def _field_words(field: str) -> int:
     from gubernator_tpu.ops.buckets import _FLOAT, _WIDE
@@ -182,6 +187,7 @@ def scatter_rows(table: jnp.ndarray, slots: jnp.ndarray,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((cap1, w), jnp.int32),
             input_output_aliases={2: 0},
+            compiler_params=_COMPILER_PARAMS,
             interpret=_interpret(),
         )(slots, rows, table)
 
@@ -202,6 +208,7 @@ def gather_rows(table: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
             _gather_kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, w), jnp.int32),
+            compiler_params=_COMPILER_PARAMS,
             interpret=_interpret(),
         )(slots, table)
 

@@ -539,13 +539,12 @@ class MeshGlobalEngine:
                 packed.append((d, col, j, r, slot, known, ge, gd))
                 col += 1
         if packed:
-            dd = np.fromiter((p[0] for p in packed), np.int64, len(packed))
-            cc = np.fromiter((p[1] for p in packed), np.int64, len(packed))
+            dd_l, cc_l, jj, reqs_l, slot_l, known_l, ge_l, gd_l = zip(*packed)
+            dd = np.asarray(dd_l, np.int64)
+            cc = np.asarray(cc_l, np.int64)
             pack_request_matrix(
-                m, cc, [p[3] for p in packed],
-                [p[4] for p in packed], [p[5] for p in packed], now,
-                nodes=dd,
-                greg=([p[6] for p in packed], [p[7] for p in packed]),
+                m, cc, reqs_l, slot_l, known_l, now,
+                nodes=dd, greg=(ge_l, gd_l),
             )
             self.state, self.aux, self.accum, resp = self._proc(
                 self.state, self.aux, self.accum,
@@ -557,8 +556,8 @@ class MeshGlobalEngine:
             status, limit_o, remaining, reset = (
                 rm[dd, r, cc].tolist() for r in range(4)
             )
-            for t, p in enumerate(packed):
-                out[p[0]][p[2]] = RateLimitResponse(
+            for t, (d, j) in enumerate(zip(dd_l, jj)):
+                out[d][j] = RateLimitResponse(
                     status=status[t], limit=limit_o[t],
                     remaining=remaining[t], reset_time=reset[t],
                 )
