@@ -41,6 +41,7 @@ semantically identical) so the row engine is testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -58,8 +59,26 @@ from gubernator_tpu.ops.buckets import (
 )
 
 ROW_W = 128     # int32 words per row (Mosaic lane-alignment minimum)
-DMA_RING = 32   # in-flight DMA ring depth
-DMA_UNROLL = 4  # DMAs issued per scalar-loop step
+# DMA pipeline shape (env-overridable for per-platform tuning): ring
+# depth bounds outstanding copies — gathers are HBM-read-latency bound,
+# so deeper rings hide more latency — and the unroll sets how many
+# copies each scalar-loop step issues (the scalar loop is the issue-rate
+# limiter).
+def _env_pow2(name: str, default: int, lo: int, hi: int) -> int:
+    """Clamped power-of-two env knob: a malformed or out-of-range value
+    falls back to the default (a 0-deep ring would deadlock the first
+    tick waiting on DMAs that were never started)."""
+    try:
+        v = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    if v < lo or v > hi or v & (v - 1):
+        return default
+    return v
+
+
+DMA_RING = _env_pow2("GUBER_TPU_DMA_RING", 32, 8, 256)
+DMA_UNROLL = _env_pow2("GUBER_TPU_DMA_UNROLL", 4, 1, 16)
 
 # The kernels stage the whole (B, ROW_W) batch block in VMEM; Mosaic's
 # default scoped-vmem budget rejects a 64k-row tick (gather out-block +

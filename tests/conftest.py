@@ -13,23 +13,31 @@ tunnel health.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# GUBER_TEST_TPU=1 runs the suite against the real device (row-layout
+# kernels under the actual Mosaic compiler instead of interpret mode);
+# default is the hermetic 8-device CPU mesh.
+TEST_TPU = os.environ.get("GUBER_TEST_TPU") == "1"
+if not TEST_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = ":".join(
-    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
-)
+if not TEST_TPU:
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = ":".join(
+        p for p in os.environ.get("PYTHONPATH", "").split(":")
+        if ".axon_site" not in p
+    )
 
 import jax  # noqa: E402
 
 # The tunnel plugin's sitecustomize may have already registered the axon
 # backend and forced jax_platforms="axon,cpu" via config (which outranks
 # the env var) — force cpu back so tests are hermetic.
-jax.config.update("jax_platforms", "cpu")
+if not TEST_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
