@@ -1,0 +1,108 @@
+"""Config-surface tests (the reference's config_test.go analog):
+env-first GUBER_* reads, config-file-into-env loading, Go-style duration
+parsing, eager validation, and the defaults table of config.go:126-141.
+"""
+
+import pytest
+
+from gubernator_tpu.config import (
+    DaemonConfig,
+    load_config_file,
+    parse_duration,
+    setup_daemon_config,
+)
+from gubernator_tpu.ops.engine import make_layout_choice
+
+
+def conf_from(env, config_file=""):
+    return setup_daemon_config(config_file=config_file, environ=env)
+
+
+def test_defaults_match_reference():
+    c = conf_from({})
+    b = c.config.behaviors
+    # config.go:126-141 defaults
+    assert b.batch_timeout == pytest.approx(0.5)
+    assert b.batch_wait == pytest.approx(500e-6)
+    assert b.batch_limit == 1000
+    assert b.global_timeout == pytest.approx(0.5)
+    assert b.global_batch_limit == 1000
+    assert b.global_sync_wait == pytest.approx(0.1)
+    assert c.config.cache_size == 50_000
+    assert c.config.replicas == 512
+    assert c.config.local_picker_hash == "fnv1"
+    assert c.config.tpu_table_layout == "auto"
+
+
+def test_env_overrides_flow_through():
+    c = conf_from({
+        "GUBER_GRPC_ADDRESS": "1.2.3.4:81",
+        "GUBER_CACHE_SIZE": "1234",
+        "GUBER_BATCH_WAIT": "2ms",
+        "GUBER_PEER_PICKER_HASH": "fnv1a",
+        "GUBER_TPU_TABLE_LAYOUT": "columns",
+        "GUBER_TPU_MAX_BATCH": "512",
+        "GUBER_DATA_CENTER": "dc-7",
+    })
+    assert c.grpc_listen_address == "1.2.3.4:81"
+    assert c.config.cache_size == 1234
+    assert c.config.behaviors.batch_wait == pytest.approx(2e-3)
+    assert c.config.local_picker_hash == "fnv1a"
+    assert c.config.tpu_table_layout == "columns"
+    assert c.config.tpu_max_batch == 512
+    assert c.data_center == "dc-7"
+
+
+def test_config_file_loads_into_env(tmp_path):
+    p = tmp_path / "guber.conf"
+    p.write_text(
+        "# comment line\n"
+        "\n"
+        "GUBER_CACHE_SIZE=777\n"
+        "GUBER_LOG_LEVEL=debug\n"
+    )
+    env = {"GUBER_CACHE_SIZE": "999"}  # env wins over file (env-first)
+    c = conf_from(env, config_file=str(p))
+    assert c.log_level == "debug"
+    # the file loads INTO the env but a real env var wins
+    # (config.go:635-658: set only when unset)
+    assert c.config.cache_size == 999
+
+
+def test_config_file_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.conf"
+    p.write_text("THIS IS NOT KEY VALUE\n")
+    with pytest.raises(ValueError):
+        load_config_file(str(p), {})
+
+
+def test_duration_suffixes():
+    assert parse_duration("500ms") == pytest.approx(0.5)
+    assert parse_duration("100us") == pytest.approx(100e-6)
+    assert parse_duration("30s") == pytest.approx(30.0)
+    assert parse_duration("1m") == pytest.approx(60.0)
+    assert parse_duration("0.25") == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize("env", [
+    {"GUBER_PEER_PICKER_HASH": "md5"},
+    {"GUBER_PEER_PICKER": "consistent-hash"},
+    {"GUBER_PEER_DISCOVERY_TYPE": "zookeeper"},
+    {"GUBER_CACHE_SIZE": "not-a-number"},
+])
+def test_eager_validation_rejects(env):
+    with pytest.raises(ValueError):
+        conf_from(env)
+
+
+def test_layout_choice_rules():
+    import jax
+
+    cpu = jax.devices("cpu")[0]
+    # CPU never auto-selects the Pallas row layout
+    assert make_layout_choice("auto", 1 << 16, cpu, 4096) == "columns"
+    # explicit settings are honored anywhere, bad ones rejected
+    assert make_layout_choice("row", 1 << 16, cpu, 4096) == "row"
+    assert make_layout_choice("columns", 1 << 16, cpu, 4096) == "columns"
+    with pytest.raises(ValueError):
+        make_layout_choice("rows", 1 << 16, cpu, 4096)
