@@ -291,8 +291,10 @@ def setup_daemon_config(
     # Re-apply the compile-cache knob: a config file loads into the
     # environment after the import-time default was chosen.
     from gubernator_tpu import configure_compile_cache
+    from gubernator_tpu.ops.rowtable import refresh_dma_tuning
 
     configure_compile_cache(env)
+    refresh_dma_tuning(env)
     r = EnvReader(env)
 
     behaviors = BehaviorConfig(

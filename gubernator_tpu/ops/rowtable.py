@@ -41,6 +41,7 @@ semantically identical) so the row engine is testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import NamedTuple
 
@@ -64,21 +65,38 @@ ROW_W = 128     # int32 words per row (Mosaic lane-alignment minimum)
 # so deeper rings hide more latency — and the unroll sets how many
 # copies each scalar-loop step issues (the scalar loop is the issue-rate
 # limiter).
-def _env_pow2(name: str, default: int, lo: int, hi: int) -> int:
+def _env_pow2(env, name: str, default: int, lo: int, hi: int) -> int:
     """Clamped power-of-two env knob: a malformed or out-of-range value
-    falls back to the default (a 0-deep ring would deadlock the first
-    tick waiting on DMAs that were never started)."""
-    try:
-        v = int(os.environ.get(name, default))
-    except ValueError:
+    falls back to the default with a warning (a 0-deep ring would
+    deadlock the first tick waiting on DMAs that were never started)."""
+    raw = env.get(name, "")
+    if raw == "":
         return default
+    try:
+        v = int(raw)
+    except ValueError:
+        v = -1
     if v < lo or v > hi or v & (v - 1):
+        logging.getLogger("gubernator_tpu").warning(
+            "%s=%r is not a power of two in [%d, %d]; using %d",
+            name, raw, lo, hi, default,
+        )
         return default
     return v
 
 
-DMA_RING = _env_pow2("GUBER_TPU_DMA_RING", 32, 8, 256)
-DMA_UNROLL = _env_pow2("GUBER_TPU_DMA_UNROLL", 4, 1, 16)
+def refresh_dma_tuning(environ=None) -> None:
+    """(Re-)read the DMA pipeline knobs.  Runs at import AND again from
+    ``setup_daemon_config`` so the knobs also work from a ``-config``
+    file, which loads into the env copy after import (the
+    configure_compile_cache pattern, gubernator_tpu/__init__.py)."""
+    global DMA_RING, DMA_UNROLL
+    env = os.environ if environ is None else environ
+    DMA_RING = _env_pow2(env, "GUBER_TPU_DMA_RING", 32, 8, 256)
+    DMA_UNROLL = _env_pow2(env, "GUBER_TPU_DMA_UNROLL", 4, 1, 16)
+
+
+refresh_dma_tuning()
 
 # The kernels stage the whole (B, ROW_W) batch block in VMEM; Mosaic's
 # default scoped-vmem budget rejects a 64k-row tick (gather out-block +

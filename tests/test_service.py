@@ -352,3 +352,41 @@ async def test_global_negative_hits():
         await send_hit(peers[0], 0, 0)
     finally:
         await c.stop()
+
+
+async def test_forward_retry_exhaustion_and_self_upgrade():
+    """The ≤5-retry forward loop (gubernator.go:311-391): a dead owner
+    exhausts retries into the reference's "peers that are not connected"
+    error; once ownership re-resolves to this node, the retry self-
+    upgrades to local handling instead of forwarding."""
+    c = await Cluster.start(2)
+    try:
+        d_owner = c.find_owning_daemon("retrytest", "rk")
+        d_other = next(d for d in c.daemons if d is not d_owner)
+
+        # Kill the owner: forwards now fail UNAVAILABLE and re-resolution
+        # keeps returning the same dead peer.
+        await d_owner.close()
+        out = await d_other.instance.get_rate_limits(
+            [req(name="retrytest", key="rk")]
+        )
+        assert "not connected" in out[0].error
+        assert d_other.metrics.registry.get_sample_value(
+            "gubernator_batch_send_retries_total"
+        ) >= 5
+
+        # Self-upgrade: ownership moves to the surviving node; the retry
+        # path must answer locally (attempts != 0 and peer.is_owner).
+        dead_peer = d_other.instance.get_peer("retrytest_rk")
+        from gubernator_tpu.config import PeerInfo
+
+        d_other.set_peers(
+            [PeerInfo(grpc_address=d_other.advertise_address)]
+        )
+        resp = await d_other.instance._async_request(
+            dead_peer, req(name="retrytest", key="rk"), "retrytest_rk"
+        )
+        assert resp.error == ""
+        assert resp.remaining == 4
+    finally:
+        await c.stop()
