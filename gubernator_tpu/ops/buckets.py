@@ -192,6 +192,19 @@ class BucketState(NamedTuple):
     def capacity(self) -> int:
         return self.algorithm.shape[0]
 
+    @classmethod
+    def zeros_logical(cls, n: int) -> "BucketState":
+        """Logical-dtype all-zero rows (an absent item's state — what a
+        new slot reads and what eviction writes back)."""
+        def z(f):
+            if f in _WIDE:
+                return jnp.zeros(n, I64)
+            if f in _FLOAT:
+                return jnp.zeros(n, F64)
+            return jnp.zeros(n, STATE_DTYPES[f])
+
+        return cls(**{f: z(f) for f in STATE_DTYPES})
+
 
 def logical_view(state: BucketState) -> BucketState:
     """Full-table logical columns (elementwise bitcast; no data movement)."""

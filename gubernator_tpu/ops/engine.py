@@ -870,18 +870,24 @@ def pack_restore_matrix(items: Sequence[dict], ok: np.ndarray, slots: np.ndarray
 
 
 def make_evict_fn(layout: str = "columns"):
-    """Jitted slot eviction: mark a batch of slots unused (LRU reclamation).
+    """Jitted slot eviction: zero a batch of slots (LRU reclamation).
 
-    Column layout clears ``in_use``; row layout zeroes the whole row (same
-    observable state: a zero row is exactly a never-used slot)."""
+    Both layouts zero the WHOLE row, not just ``in_use``: an evicted item
+    is removed in the reference (lrucache.go:138-149), and stale
+    don't-care fields would otherwise leak into the next tenant's
+    snapshot when the slot is reborn under the other algorithm."""
 
     if layout == "row":
         return rowtable.row_evict
 
     def evict(state: BucketState, slots: jnp.ndarray) -> BucketState:
-        return state._replace(
-            in_use=state.in_use.at[slots].set(False, mode="drop")
-        )
+        # Zero the whole row, not just in_use: an evicted item is REMOVED
+        # in the reference (lrucache.go:138-149), and leaving stale
+        # don't-care fields behind leaks them into the next tenant's
+        # snapshot when the slot is reborn under the other algorithm
+        # (found by the row/column fuzz parity suite).
+        zeros = BucketState.zeros_logical(slots.shape[0])
+        return scatter_state(state, slots, zeros)
 
     return evict
 

@@ -364,7 +364,13 @@ def make_global_evict_fn(mesh: Mesh):
 
     def _evict(state_blk, aux_blk, accum_blk, slots):
         st = jax.tree.map(lambda a: a[0], state_blk)
-        st = st._replace(in_use=st.in_use.at[slots].set(False, mode="drop"))
+        # Zero the whole row, not just in_use: an evicted item is REMOVED
+        # (lrucache.go:138-149), and stale don't-care fields would leak
+        # into peek()/snapshots when the slot is reborn under the other
+        # algorithm (same fix as the local engines' evict).
+        from gubernator_tpu.ops.buckets import BucketState as _BS
+
+        st = scatter_state(st, slots, _BS.zeros_logical(slots.shape[0]))
         aux = aux_blk[0].at[AUX["stamp"], slots].set(0, mode="drop")
         acc = accum_blk[0].at[:, slots].set(0, mode="drop")
         return (

@@ -217,7 +217,12 @@ async def test_mtls_cluster_forwarding(ca_files):
         if key is not None:
             break
         await asyncio.sleep(0.05)
-    assert key is not None
+    probe = d1.instance.get_peer("test_tls_k0")
+    assert key is not None, (
+        f"no non-owned key after 5s: d1={d1.conf.grpc_listen_address} "
+        f"d2={d2.conf.grpc_listen_address} peers={d1.peer_info} "
+        f"probe={(probe.info if probe else None)}"
+    )
 
     client = DaemonClient(
         d1.conf.grpc_listen_address, credentials=d1.tls.channel_credentials()
