@@ -301,40 +301,49 @@ async def _service_bench(n_batches, batch, concurrency):
     conf.config = Config(behaviors=BehaviorConfig(), cache_size=1 << 20)
     d = await spawn_daemon(conf)
     client = DaemonClient(d.advertise_address)
-    rng = np.random.default_rng(3)
+    # Everything after the daemon exists runs under try/finally: r02's
+    # DEADLINE_EXCEEDED escaped before d.close(), leaking the grpc.aio
+    # server into interpreter shutdown where Server.__del__ aborts the
+    # whole process (rc=134) after the headline JSON already printed.
+    try:
+        rng = np.random.default_rng(3)
 
-    def mk(i):
-        ids = rng.integers(0, 100_000, batch)
-        return [
-            RateLimitRequest(
-                name="svc",
-                unique_key=str(k),
-                hits=1,
-                limit=1_000_000,
-                duration=3_600_000,
-            )
-            for k in ids
-        ]
+        def mk(i):
+            ids = rng.integers(0, 100_000, batch)
+            return [
+                RateLimitRequest(
+                    name="svc",
+                    unique_key=str(k),
+                    hits=1,
+                    limit=1_000_000,
+                    duration=3_600_000,
+                )
+                for k in ids
+            ]
 
-    payloads = [mk(i) for i in range(min(n_batches, 32))]
-    await client.get_rate_limits(payloads[0], timeout=60.0)  # warm
+        payloads = [mk(i) for i in range(min(n_batches, 32))]
+        await client.get_rate_limits(payloads[0], timeout=120.0)  # warm
 
-    lat = []
-    sem = asyncio.Semaphore(concurrency)
+        lat = []
+        sem = asyncio.Semaphore(concurrency)
 
-    async def one(i):
-        async with sem:
-            t0 = time.perf_counter()
-            # Generous deadline: tunneled-device latency spikes to tens of
-            # ms per transfer and queued batches stack behind the tick.
-            await client.get_rate_limits(payloads[i % len(payloads)], timeout=60.0)
-            lat.append((time.perf_counter() - t0) * 1e3)
+        async def one(i):
+            async with sem:
+                t0 = time.perf_counter()
+                # Generous deadline: tunneled-device latency spikes to tens
+                # of ms per transfer and queued batches stack behind the
+                # tick.
+                await client.get_rate_limits(
+                    payloads[i % len(payloads)], timeout=60.0
+                )
+                lat.append((time.perf_counter() - t0) * 1e3)
 
-    t0 = time.perf_counter()
-    await asyncio.gather(*(one(i) for i in range(n_batches)))
-    dt = time.perf_counter() - t0
-    await client.close()
-    await d.close()
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(n_batches)))
+        dt = time.perf_counter() - t0
+    finally:
+        await client.close()
+        await d.close()
     p50, p99 = _pcts(lat)
     return {
         "rung": "service_grpc",

@@ -791,7 +791,14 @@ def make_readback_fn(layout: str = "columns"):
     _, _gather, _scatter = _layout_ops(layout)
 
     def readback(state, slots: jnp.ndarray):
-        rows = _gather(state, slots, fill=True)
+        # Column layout zero-fills out-of-range slots; the row layout has
+        # no fill option (guard-row garbage instead) — callers never read
+        # past their real batch, so both contracts are safe here.
+        rows = (
+            _gather(state, slots)
+            if layout == "row"
+            else _gather(state, slots, fill=True)
+        )
         ints = jnp.stack(
             [
                 rows.algorithm.astype(jnp.int64),

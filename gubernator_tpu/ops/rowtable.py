@@ -89,13 +89,30 @@ def refresh_dma_tuning(environ=None) -> None:
     """(Re-)read the DMA pipeline knobs.  Runs at import AND again from
     ``setup_daemon_config`` so the knobs also work from a ``-config``
     file, which loads into the env copy after import (the
-    configure_compile_cache pattern, gubernator_tpu/__init__.py)."""
+    configure_compile_cache pattern, gubernator_tpu/__init__.py).
+
+    The kernels bake DMA_RING/DMA_UNROLL in at trace time and the jitted
+    wrappers are cached by (capacity, layout) only — once any kernel has
+    been traced, a change here could not take effect for those programs
+    and two engines in one process would silently disagree.  So a
+    post-trace change is *refused* (loudly): refresh must precede the
+    first engine construction."""
     global DMA_RING, DMA_UNROLL
     env = os.environ if environ is None else environ
-    DMA_RING = _env_pow2(env, "GUBER_TPU_DMA_RING", 32, 8, 256)
-    DMA_UNROLL = _env_pow2(env, "GUBER_TPU_DMA_UNROLL", 4, 1, 16)
+    ring = _env_pow2(env, "GUBER_TPU_DMA_RING", 32, 8, 256)
+    unroll = _env_pow2(env, "GUBER_TPU_DMA_UNROLL", 4, 1, 16)
+    if _KERNELS_TRACED and (ring, unroll) != (DMA_RING, DMA_UNROLL):
+        logging.getLogger("gubernator_tpu").warning(
+            "DMA tuning change (ring %d->%d, unroll %d->%d) ignored: row "
+            "kernels were already traced with the old values; set "
+            "GUBER_TPU_DMA_* before the first engine is constructed",
+            DMA_RING, ring, DMA_UNROLL, unroll,
+        )
+        return
+    DMA_RING, DMA_UNROLL = ring, unroll
 
 
+_KERNELS_TRACED = False
 refresh_dma_tuning()
 
 # The kernels stage the whole (B, ROW_W) batch block in VMEM; Mosaic's
@@ -206,6 +223,8 @@ def scatter_rows(table: jnp.ndarray, slots: jnp.ndarray,
     rows, install/restore/evict dedup'd slots); duplicates of the guard
     row ``capacity`` are harmless (its content is never read as data).
     """
+    global _KERNELS_TRACED
+    _KERNELS_TRACED = True
     b, w = rows.shape
     cap1 = table.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -231,6 +250,8 @@ def scatter_rows(table: jnp.ndarray, slots: jnp.ndarray,
 
 def gather_rows(table: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     """Read ``table[slots[j]]`` into a (B, ROW_W) matrix (row DMAs)."""
+    global _KERNELS_TRACED
+    _KERNELS_TRACED = True
     b = slots.shape[0]
     w = table.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -286,11 +307,13 @@ def logical_to_matrix(rows: BucketState) -> jnp.ndarray:
 # ----------------------------------------------------------------------
 # BucketState-helper equivalents over RowState
 # ----------------------------------------------------------------------
-def row_gather_state(state: RowState, idx: jnp.ndarray,
-                     fill: bool = False) -> BucketState:
+def row_gather_state(state: RowState, idx: jnp.ndarray) -> BucketState:
     """Gather logical rows at ``idx``.  Out-of-range/padding indices clamp
     to the guard row and read garbage — callers mask those lanes (the
-    column path's fill-with-zeros contract, weakened to "don't read")."""
+    column path's fill-with-zeros contract, weakened to "don't read").
+    Unlike ``buckets.gather_state`` there is deliberately no ``fill``
+    option: zero-filling would cost a second masked pass per lane, and
+    every caller already ignores padding rows."""
     cap = state.capacity
     slots = jnp.clip(idx, 0, cap).astype(jnp.int32)
     return matrix_to_logical(gather_rows(state.table, slots))

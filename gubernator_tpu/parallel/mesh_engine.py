@@ -314,10 +314,19 @@ class MeshTickEngine:
             rs = sel[sl >= 0]
             pos[rs] = np.arange(len(rs))
 
-        ok = (slots >= 0) & (pos >= 0) & (pos < b)
-        g_ok = shards[ok] * self.local_capacity + slots[ok]
-        self._last_access[g_ok] = self._tick_count
-        self._pending.update(g_ok[known[ok] == 0].tolist())
+        # Stamp EVERY resolved row live — including block-overflow spills
+        # (pos >= b): their slots are assigned but unwritten until the
+        # retry tick, and an unstamped reclaim (e.g. from install_globals
+        # between calls) could unmap a slot whose spill retry is pending.
+        resolved = slots >= 0
+        g_res = shards[resolved] * self.local_capacity + slots[resolved]
+        self._last_access[g_res] = self._tick_count
+        self._pending.update(g_res[known[resolved] == 0].tolist())
+        ok = resolved & (pos >= 0) & (pos < b)
+        # New slots of spilled rows must survive the post-tick pending
+        # clear: this tick does not write them.
+        spilled_new = resolved & ~ok & (known == 0)
+        g_spill_new = shards[spilled_new] * self.local_capacity + slots[spilled_new]
         spill = [idx[j] for j in np.flatnonzero(~ok)]
         sel = np.flatnonzero(ok)
         if len(sel) == 0:
@@ -338,6 +347,7 @@ class MeshTickEngine:
         )
         self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(now))
         self._pending.clear()
+        self._pending.update(g_spill_new.tolist())
         rm = np.asarray(resp)  # (n_shards, 5, B)
         self.metric_over_limit += int(rm[sh, 4, ps].sum())
         status, limit_o, remaining, reset = (
