@@ -155,6 +155,13 @@ class NativeSlotMap:
         blob = b"".join(keys)
         offsets = np.zeros(n + 1, np.int64)
         np.cumsum([len(k) for k in keys], out=offsets[1:])
+        return self.resolve_blob(blob, offsets)
+
+    def resolve_blob(self, blob: bytes, offsets: np.ndarray):
+        """resolve_batch on pre-packed (blob, offsets) — the columnar hot
+        path's native call: no per-key Python at all."""
+        n = len(offsets) - 1
+        offsets = np.ascontiguousarray(offsets, np.int64)
         slots = np.empty(n, np.int64)
         known = np.empty(n, np.uint8)
         self._lib.guber_slotmap_resolve_batch(

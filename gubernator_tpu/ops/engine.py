@@ -47,6 +47,7 @@ from gubernator_tpu.ops.buckets import (
     scatter_state,
 )
 from gubernator_tpu.ops import rowtable
+from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns
 from gubernator_tpu.ops.rowtable import RowState
 from gubernator_tpu.types import (
     Algorithm,
@@ -997,6 +998,14 @@ class SlotMap:
                 known[j] = 0
         return slots, known
 
+    def resolve_blob(self, blob: bytes, offsets: np.ndarray):
+        """(slots, known) for keys packed as one blob + offsets (the
+        columnar hot-path format; NativeSlotMap resolves this with zero
+        per-key Python)."""
+        return self.resolve_batch(
+            [blob[offsets[j] : offsets[j + 1]] for j in range(len(offsets) - 1)]
+        )
+
     def release_batch(self, slots: np.ndarray) -> None:
         for s in slots:
             self.release(int(s))
@@ -1105,6 +1114,74 @@ def make_slot_map(capacity: int):
         return NativeSlotMap(capacity)
     except Exception:
         return SlotMap(capacity)
+
+
+class TickHandle:
+    """One dispatched tick: device work is queued, host readback deferred.
+
+    ``result()`` materializes the (5, n) response matrix in request order
+    (rows: status, limit, remaining, reset_time, over_limit) and runs the
+    deferred per-tick bookkeeping (over-limit metric, Store write-through).
+    Idempotent; safe to call from a different thread than the dispatcher.
+    """
+
+    __slots__ = ("_engine", "_resp", "_n", "_inv", "errors", "_refs",
+                 "_slots_req", "_done")
+
+    def __init__(self, engine, resp, n, inv, errors, refs, slots_req):
+        self._engine = engine
+        self._resp = resp
+        self._n = n
+        self._inv = inv
+        self.errors = errors
+        self._refs = refs
+        self._slots_req = slots_req
+        self._done: Optional[np.ndarray] = None
+
+    def result(self) -> tuple[np.ndarray, Dict[int, str]]:
+        if self._done is None:
+            # One D2H; the [:, inv] un-permutes the slot-sorted batch.
+            rm = np.asarray(self._resp)[:, : self._n][:, self._inv]
+            eng = self._engine
+            with eng._lock:
+                eng.metric_over_limit += int(rm[4].sum())
+                if eng.store is not None:
+                    eng._write_through(
+                        self._refs, self._slots_req, self._n, self.errors
+                    )
+            self._resp = None  # release the device buffer reference
+            self._done = rm
+        return self._done, self.errors
+
+
+class SubmittedBatch:
+    """A dispatched object-level batch (one or more chunked ticks); the
+    tick loop resolves it off the dispatch thread."""
+
+    __slots__ = ("_handles", "_spans", "_n")
+
+    def __init__(self, handles, spans, n):
+        self._handles = handles
+        self._spans = spans
+        self._n = n
+
+    def responses(self) -> List[RateLimitResponse]:
+        out: List[Optional[RateLimitResponse]] = [None] * self._n
+        for h, (s, e) in zip(self._handles, self._spans):
+            rm, errors = h.result()
+            status, limit, remaining, reset = (rm[r].tolist() for r in range(4))
+            for i in range(e - s):
+                out[s + i] = (
+                    RateLimitResponse(error=errors[i])
+                    if i in errors
+                    else RateLimitResponse(
+                        status=status[i],
+                        limit=limit[i],
+                        remaining=remaining[i],
+                        reset_time=reset[i],
+                    )
+                )
+        return out  # type: ignore[return-value]
 
 
 class TickEngine:
@@ -1236,15 +1313,16 @@ class TickEngine:
         self.slots.release_batch(victims)
         self.state = evict_chunked(self._evict, self.state, victims, self.capacity)
 
-    def build_batch(
-        self, requests: Sequence[RateLimitRequest], now: int
-    ) -> tuple[np.ndarray, int]:
-        """Resolve keys to slots and pack the padded (12, B) request matrix.
+    def _build_cols(self, cols: ReqColumns, now: int):
+        """Resolve keys to slots and pack the padded (12, B) request matrix
+        from a columnar batch — zero per-request Python on the no-error,
+        no-store path: one native blob resolve + a dozen vectorized numpy
+        writes + one argsort.
 
         A single int64 matrix means one H2D transfer per tick; per-transfer
         latency dominates small ticks.
         """
-        n = len(requests)
+        n = len(cols)
         if n > self.max_batch:
             raise ValueError(f"batch of {n} exceeds engine max {self.max_batch}")
         # Width quantization: a tick's device cost scales with the padded
@@ -1257,35 +1335,34 @@ class TickEngine:
         m[R["slot"]] = self.capacity  # padding scatters out of bounds
         errors: Dict[int, str] = {}
 
-        # Raw-int behaviors once per batch: IntFlag's __and__ allocates an
-        # enum instance per call, which profiled as the single largest host
-        # cost of a 4096-wide tick.
-        behav = [int(r.behavior) for r in requests]
-        GREG = int(Behavior.DURATION_IS_GREGORIAN)
-
         # Gregorian resolution (host-side calendar math) — only requests
         # carrying the flag pay for it; failures become per-item errors.
-        greg_idx = [i for i, b in enumerate(behav) if b & GREG]
-        for i in greg_idx:
-            try:
-                e, d = resolve_gregorian(requests[i], now)
-                m[R["greg_exp"], i] = e
-                m[R["greg_dur"], i] = d
-            except timeutil.GregorianError as exc:
-                errors[i] = str(exc)
-
-        if errors:
-            sel = np.array([i for i in range(n) if i not in errors], np.int64)
-        else:
-            sel = np.arange(n, dtype=np.int64)
-        if len(sel) == 0:
-            return m, n, errors, np.arange(n, dtype=np.int64)
+        GREG = int(Behavior.DURATION_IS_GREGORIAN)
+        greg = cols.behavior & GREG
+        if greg.any():
+            for i in np.flatnonzero(greg):
+                try:
+                    d = int(cols.duration[i])
+                    m[R["greg_exp"], i] = timeutil.gregorian_expiration(now, d)
+                    m[R["greg_dur"], i] = timeutil.gregorian_duration(now, d)
+                except timeutil.GregorianError as exc:
+                    errors[int(i)] = str(exc)
 
         # One native call resolves every key to a slot (the reference does a
         # per-key map lookup inside each worker goroutine; here it's a batch
-        # against the C++ open-addressing table).
-        keys = [requests[i].hash_key().encode() for i in sel]
-        slots, known = self.slots.resolve_batch(keys)
+        # against the C++ open-addressing table, fed the key blob directly).
+        if errors:
+            sel = np.array([i for i in range(n) if i not in errors], np.int64)
+            if len(sel) == 0:
+                return m, n, errors, np.arange(n, dtype=np.int64)
+            slots, known = self.slots.resolve_batch(
+                [cols.key_bytes(int(i)) for i in sel]
+            )
+        else:
+            sel = None  # the whole batch, contiguous
+            slots, known = self.slots.resolve_blob(
+                cols.key_blob, cols.key_offsets
+            )
         if (slots < 0).any():
             # Stamp the already-resolved rows live *before* reclaiming:
             # fresh misses look unused on device and known slots carry a
@@ -1301,7 +1378,10 @@ class TickEngine:
             needed = int((~ok).sum())
             self._reclaim(now, want=max(needed, self.capacity // 16))
             retry = np.flatnonzero(slots < 0)
-            s2, k2 = self.slots.resolve_batch([keys[j] for j in retry])
+            retry_src = retry if sel is None else sel[retry]
+            s2, k2 = self.slots.resolve_batch(
+                [cols.key_bytes(int(j)) for j in retry_src]
+            )
             slots[retry] = s2
             known[retry] = k2
             if (slots < 0).any():
@@ -1313,15 +1393,32 @@ class TickEngine:
         self.metric_misses += int(miss.sum())
 
         if self.store is not None and miss.any():
-            self._read_through(requests, sel, slots, known, miss)
+            if cols.refs is None:
+                raise ValueError(
+                    "Store read-through needs request objects; build the "
+                    "batch with ReqColumns.from_requests(..., keep_refs=True)"
+                )
+            rt_sel = np.arange(n, dtype=np.int64) if sel is None else sel
+            self._read_through(cols.refs, rt_sel, slots, known, miss)
 
-        # Column-wise packing: one pass over the requests collecting every
-        # field, then one vectorized write per row (greg rows were written
-        # above).
-        pack_request_matrix(
-            m, sel, [requests[i] for i in sel], slots, known, now,
-            behav=[behav[i] for i in sel],
-        )
+        # Vectorized pack: plain slices on the (typical) no-error batch,
+        # fancy-indexed writes when error rows must be skipped.
+        ix = slice(0, n) if sel is None else sel
+
+        def put(row, vals):
+            m[R[row], ix] = vals
+
+        put("slot", slots)
+        put("known", known)
+        put("hits", cols.hits[ix])
+        put("limit", cols.limit[ix])
+        put("duration", cols.duration[ix])
+        put("algorithm", cols.algorithm[ix])
+        put("behavior", cols.behavior[ix])
+        ca = cols.created_at[ix]
+        put("created_at", np.where(ca != CREATED_UNSET, ca, now))
+        put("burst", cols.burst[ix])
+        put("valid", 1)
         # Sort the batch by slot (stable: same-slot requests keep arrival
         # order, the duplicate-sequencing contract).  The tick's
         # sorted-input path then does all segment math with neighbor
@@ -1372,50 +1469,103 @@ class TickEngine:
     # ------------------------------------------------------------------
     # The tick
     # ------------------------------------------------------------------
+    def submit_columns(
+        self, cols: ReqColumns, now: Optional[int] = None
+    ) -> "TickHandle":
+        """Build + dispatch one tick (≤ max_batch rows) and return a handle.
+
+        Device work (H2D, tick, response buffer) is *queued*, not awaited —
+        the caller materializes via :meth:`TickHandle.result`, so host
+        packing of the next tick overlaps device execution of this one
+        (the double-buffering SURVEY §7 calls for; the round-2 engine
+        serialized pack → dispatch → blocking D2H and paid the sum).
+
+        With a Store attached the handle is resolved before return (the
+        write-through readback must observe exactly this tick's state, so
+        no later tick may be dispatched first).
+        """
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            self._tick_count += 1
+            packed, n, errors, inv = self._build_cols(cols, now)
+            # Named range in XProf captures (utils/tracing.py): device
+            # tick vs host packing shows up separated in the profile.
+            with tracing.profile_annotation("guber.tick"):
+                self.state, resp = self._tick(
+                    self.state, jnp.asarray(packed), jnp.int64(now)
+                )
+            self._pending.clear()
+            slots_req = (
+                packed[REQ_ROW_INDEX["slot"], :n][inv]
+                if self.store is not None
+                else None
+            )
+            handle = TickHandle(self, resp, n, inv, errors, cols.refs, slots_req)
+            if self.store is not None:
+                handle.result()
+            return handle
+
+    def process_columns(
+        self, cols: ReqColumns, now: Optional[int] = None
+    ) -> tuple[np.ndarray, Dict[int, str]]:
+        """Apply a columnar batch; returns the (5, n) response matrix in
+        request order (rows: status, limit, remaining, reset_time,
+        over_limit) plus per-item errors.  Batches wider than ``max_batch``
+        run as a pipeline of chunked ticks: chunk k+1 is packed and
+        dispatched while chunk k executes on device."""
+        n = len(cols)
+        if n == 0:
+            return np.zeros((5, 0), np.int64), {}
+        now = now if now is not None else timeutil.now_ms()
+        if n <= self.max_batch:
+            return self.submit_columns(cols, now).result()
+        spans = [
+            (s, min(s + self.max_batch, n))
+            for s in range(0, n, self.max_batch)
+        ]
+        handles = [
+            self.submit_columns(cols.slice_chunk(s, e), now) for s, e in spans
+        ]
+        out = np.empty((5, n), np.int64)
+        errors: Dict[int, str] = {}
+        for h, (s, e) in zip(handles, spans):
+            rm, errs = h.result()
+            out[:, s:e] = rm
+            for i, msg in errs.items():
+                errors[s + i] = msg
+        return out, errors
+
+    def submit(
+        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
+    ) -> SubmittedBatch:
+        """Dispatch an object-level batch without awaiting the device: the
+        tick loop's pipelining hook (resolve via ``.responses()`` on a
+        reader thread while this thread packs the next window)."""
+        cols = ReqColumns.from_requests(
+            requests, keep_refs=self.store is not None
+        )
+        n = len(cols)
+        now = now if now is not None else timeutil.now_ms()
+        spans = [
+            (s, min(s + self.max_batch, n))
+            for s in range(0, n, self.max_batch)
+        ]
+        handles = [
+            self.submit_columns(
+                cols if len(spans) == 1 else cols.slice_chunk(s, e), now
+            )
+            for s, e in spans
+        ]
+        return SubmittedBatch(handles, spans, n)
+
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ) -> List[RateLimitResponse]:
-        """Apply a batch of requests; returns responses in request order."""
+        """Apply a batch of requests; returns responses in request order
+        (the dataclass API edge over the columnar path)."""
         if not requests:
             return []
-        out: List[RateLimitResponse] = []
-        with self._lock:
-            now = now if now is not None else timeutil.now_ms()
-            for chunk_start in range(0, len(requests), self.max_batch):
-                chunk = requests[chunk_start : chunk_start + self.max_batch]
-                self._tick_count += 1
-                packed, n, errors, inv = self.build_batch(chunk, now)
-                # Named range in XProf captures (utils/tracing.py): device
-                # tick vs host packing shows up separated in the profile.
-                with tracing.profile_annotation("guber.tick"):
-                    self.state, resp = self._tick(
-                        self.state, jnp.asarray(packed), jnp.int64(now)
-                    )
-                self._pending.clear()
-                rm = np.asarray(resp)[:, :n][:, inv]  # one D2H, unsorted
-                self.metric_over_limit += int(rm[4, :n].sum())
-                if self.store is not None:
-                    self._write_through(
-                        chunk, packed[REQ_ROW_INDEX["slot"], :n][inv],
-                        n, errors,
-                    )
-                # tolist() converts each column to Python ints in one C
-                # call — per-element np-scalar int() was a top host cost.
-                status, limit, remaining, reset = (
-                    rm[r, :n].tolist() for r in range(4)
-                )
-                out.extend(
-                    RateLimitResponse(error=errors[i])
-                    if i in errors
-                    else RateLimitResponse(
-                        status=status[i],
-                        limit=limit[i],
-                        remaining=remaining[i],
-                        reset_time=reset[i],
-                    )
-                    for i in range(n)
-                )
-        return out
+        return self.submit(requests, now).responses()
 
     def _write_through(
         self, requests: Sequence[RateLimitRequest], slots: np.ndarray,

@@ -8,19 +8,50 @@ reference's peer-batching policy (``peer_client.go:284-337``): flush when
 first queued request — so an idle service adds zero latency and a busy one
 amortizes the device round trip over the whole window.
 
-The loop runs on a dedicated thread (device dispatch must not block the
-asyncio transport); ``submit`` is thread-safe and returns a
-``concurrent.futures.Future`` the caller can await.
+Two threads pipeline the ticks (SURVEY §7 "may need double-buffered
+ticks"): the *dispatch* thread packs window N+1 and queues its device work
+while the *resolver* thread waits out window N's D2H and completes the
+waiters' futures — so sustained throughput is bounded by
+max(host pack, device tick), not their sum.  ``submit`` is thread-safe and
+returns a ``concurrent.futures.Future`` the caller can await.
 """
 
 from __future__ import annotations
 
+import logging
+import queue
 import threading
 import time
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+# How many dispatched-but-unresolved windows may be in flight.  2 is full
+# double-buffering; a little deeper rides out D2H jitter.  The bound is the
+# backpressure: when the device falls behind, dispatch blocks here instead
+# of queueing unbounded work.
+PIPELINE_DEPTH = 4
+
+
+def _complete(fut: Future, result) -> None:
+    """set_result tolerating a concurrent cancel: asyncio.wrap_future
+    propagates waiter cancellation to the concurrent Future at any moment
+    (it is never 'running'), so check-then-set is inherently racy."""
+    try:
+        if not fut.cancelled():
+            fut.set_result(result)
+    except Exception:  # InvalidStateError: cancelled between check and set
+        pass
+
+
+def _fail_waiters(batch, exc: Exception) -> None:
+    for _, fut in batch:
+        try:
+            if not fut.cancelled():
+                fut.set_exception(exc)
+        except Exception:
+            pass
 
 
 class TickLoop:
@@ -46,8 +77,15 @@ class TickLoop:
         self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
         self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True, name="tick-loop")
+        self._resolve_q: "queue.Queue" = queue.Queue(maxsize=PIPELINE_DEPTH)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tick-loop"
+        )
+        self._resolver = threading.Thread(
+            target=self._resolve_loop, daemon=True, name="tick-resolve"
+        )
         self._thread.start()
+        self._resolver.start()
 
     def submit(
         self, requests: Sequence[RateLimitRequest]
@@ -76,6 +114,7 @@ class TickLoop:
                 while self._running and not self._pending:
                     self._cond.wait()
                 if not self._running and not self._pending:
+                    self._resolve_q.put(None)  # drain + stop the resolver
                     return
                 # Batch window: once something is queued, wait out the tick
                 # (or until the batch fills) to let more requests coalesce.
@@ -98,16 +137,58 @@ class TickLoop:
         for r, _ in batch:
             reqs.extend(r)
         t0 = time.perf_counter()
-        try:
-            out = self.engine.process(reqs)
-        except Exception as e:  # engine failure fails every waiter in the tick
-            for _, fut in batch:
-                if not fut.cancelled():
-                    fut.set_exception(e)
+        submit = getattr(self.engine, "submit", None)
+        if submit is None:
+            # Engines without the dispatch/resolve split (mesh engine):
+            # synchronous fallback, resolved inline.
+            try:
+                out = self.engine.process(reqs)
+            except Exception as e:  # engine failure fails every waiter
+                _fail_waiters(batch, e)
+                return
+            self._deliver(batch, reqs, out, time.perf_counter() - t0)
             return
+        try:
+            sb = submit(reqs)
+        except Exception as e:
+            _fail_waiters(batch, e)
+            return
+        # Bounded handoff: blocks when PIPELINE_DEPTH windows are already
+        # in flight (device behind), which is exactly the backpressure the
+        # dispatch thread should feel.
+        self._resolve_q.put((sb, batch, reqs, time.perf_counter() - t0))
+
+    def _resolve_loop(self) -> None:
+        while True:
+            item = self._resolve_q.get()
+            if item is None:
+                return
+            sb, batch, reqs, dispatch_s = item
+            # Everything below is guarded: an exception escaping this loop
+            # would kill the resolver thread and wedge the whole pipeline
+            # (dispatch eventually blocks on the bounded queue forever).
+            try:
+                t1 = time.perf_counter()
+                out = sb.responses()
+                resolve_s = time.perf_counter() - t1
+            except Exception as e:
+                _fail_waiters(batch, e)
+                continue
+            try:
+                self._deliver(batch, reqs, out, dispatch_s + resolve_s)
+            except Exception:
+                logging.getLogger("gubernator.tickloop").exception(
+                    "tick delivery failed"
+                )
+
+    def _deliver(self, batch, reqs, out, tick_s: float) -> None:
+        """Complete the waiters' futures + sync metrics.  ``tick_s`` is the
+        window's own engine time (dispatch + resolve), NOT wall time since
+        flush — under pipelining the latter would include time queued
+        behind earlier windows and misreport device health."""
         if self.metrics is not None:
             m = self.metrics
-            m.tick_duration.observe(time.perf_counter() - t0)
+            m.tick_duration.observe(tick_s)
             m.tick_batch_size.observe(len(reqs))
             m.worker_queue_length.labels(
                 method="GetRateLimits", worker="0"
@@ -135,8 +216,7 @@ class TickLoop:
                 self._synced_unexpired = unexp
         off = 0
         for r, fut in batch:
-            if not fut.cancelled():  # waiter may have timed out/cancelled
-                fut.set_result(out[off : off + len(r)])
+            _complete(fut, out[off : off + len(r)])
             off += len(r)
 
     def close(self) -> None:
@@ -144,3 +224,9 @@ class TickLoop:
             self._running = False
             self._cond.notify()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # Dispatch thread wedged (e.g. blocked on a full resolve queue
+            # with a dead resolver): don't hang close(); the daemon process
+            # is going down anyway.
+            return
+        self._resolver.join(timeout=5)

@@ -1,0 +1,128 @@
+"""Columnar request path: ReqColumns, process_columns, pipelined submit.
+
+The columnar path must be observably identical to the dataclass path —
+same decisions, same duplicate-key sequencing, same per-item errors —
+because the transport feeds it directly (no per-request objects on the
+hot path).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import TickEngine
+from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns
+from gubernator_tpu.types import Behavior, RateLimitRequest
+
+NOW = 1_700_000_000_000
+
+
+def req(key="k", hits=1, limit=10, duration=60_000, **kw):
+    return RateLimitRequest(
+        name="t", unique_key=key, hits=hits, limit=limit, duration=duration,
+        **kw,
+    )
+
+
+def test_from_requests_columns():
+    rs = [
+        req("a", hits=2, limit=5, burst=7),
+        req("b", algorithm=1, behavior=int(Behavior.DRAIN_OVER_LIMIT),
+            created_at=123),
+    ]
+    c = ReqColumns.from_requests(rs)
+    assert len(c) == 2
+    assert c.key_bytes(0) == b"t_a" and c.key_bytes(1) == b"t_b"
+    assert c.hits.tolist() == [2, 1]
+    assert c.burst.tolist() == [7, 0]
+    assert c.algorithm.tolist() == [0, 1]
+    assert c.behavior.tolist() == [0, int(Behavior.DRAIN_OVER_LIMIT)]
+    assert c.created_at.tolist() == [CREATED_UNSET, 123]
+
+
+def test_slice_and_concat_roundtrip():
+    rs = [req(f"k{i}", hits=i + 1) for i in range(10)]
+    c = ReqColumns.from_requests(rs)
+    a, b = c.slice_chunk(0, 4), c.slice_chunk(4, 10)
+    assert a.key_bytes(3) == b"t_k3"
+    assert b.key_bytes(0) == b"t_k4"
+    back = ReqColumns.concat([a, b])
+    assert back.key_blob == c.key_blob
+    assert back.key_offsets.tolist() == c.key_offsets.tolist()
+    assert back.hits.tolist() == c.hits.tolist()
+
+
+def test_process_columns_matches_process():
+    eng_a = TickEngine(capacity=256, max_batch=64)
+    eng_b = TickEngine(capacity=256, max_batch=64)
+    rs = [req(f"k{i % 5}", hits=1, limit=7) for i in range(20)]
+    expected = eng_a.process(rs, now=NOW)
+    rm, errors = eng_b.process_columns(
+        ReqColumns.from_requests(rs), now=NOW
+    )
+    assert not errors
+    assert rm[0].tolist() == [r.status for r in expected]
+    assert rm[2].tolist() == [r.remaining for r in expected]
+    assert rm[3].tolist() == [r.reset_time for r in expected]
+
+
+def test_multi_chunk_pipeline_serializes_duplicates():
+    # Batch wider than max_batch: the same key appears in both chunks and
+    # the second chunk must observe the first chunk's decrements even
+    # though both ticks are dispatched before either is materialized.
+    eng = TickEngine(capacity=128, max_batch=16)
+    rs = [req("hot", hits=1, limit=100) for _ in range(40)]
+    out = eng.process(rs, now=NOW)
+    assert [r.remaining for r in out] == list(range(99, 59, -1))
+
+
+def test_submit_is_pipelined_across_batches():
+    eng = TickEngine(capacity=128, max_batch=32)
+    s1 = eng.submit([req("x", hits=3, limit=10)], now=NOW)
+    s2 = eng.submit([req("x", hits=4, limit=10)], now=NOW)
+    # Resolve out of dispatch order: results must still be sequential.
+    r2 = s2.responses()[0]
+    r1 = s1.responses()[0]
+    assert r1.remaining == 7
+    assert r2.remaining == 3
+
+
+def test_gregorian_error_rows_in_columns():
+    eng = TickEngine(capacity=64, max_batch=32)
+    rs = [
+        req("ok", hits=1),
+        req("bad", hits=1, duration=99,
+            behavior=int(Behavior.DURATION_IS_GREGORIAN)),
+        req("ok2", hits=1),
+    ]
+    rm, errors = eng.process_columns(ReqColumns.from_requests(rs), now=NOW)
+    assert list(errors) == [1]
+    assert rm[2, 0] == 9 and rm[2, 2] == 9
+
+
+def test_columns_store_requires_refs():
+    from gubernator_tpu.store import MockStore
+
+    eng = TickEngine(capacity=64, max_batch=32, store=MockStore())
+    cols = ReqColumns.from_requests([req("s1")])  # no refs kept
+    with pytest.raises(ValueError, match="keep_refs"):
+        eng.process_columns(cols, now=NOW)
+    # With refs the store path works.
+    cols = ReqColumns.from_requests([req("s1")], keep_refs=True)
+    rm, errors = eng.process_columns(cols, now=NOW)
+    assert not errors and rm[2, 0] == 9
+
+
+def test_resolve_blob_matches_resolve_batch():
+    from gubernator_tpu.ops.engine import make_slot_map
+
+    sm = make_slot_map(32)
+    keys = [b"alpha", b"beta", b"alpha", b"g"]
+    blob = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    s1, k1 = sm.resolve_blob(blob, offsets)
+    assert k1.tolist() == [0, 0, 1, 0]  # third is a repeat of "alpha"
+    assert s1[0] == s1[2]
+    s2, k2 = sm.resolve_batch(keys)
+    assert s2.tolist() == s1.tolist()
+    assert k2.tolist() == [1, 1, 1, 1]
