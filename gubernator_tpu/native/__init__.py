@@ -151,11 +151,9 @@ class NativeSlotMap:
     def resolve_batch(self, keys: List[bytes]):
         """(slots, known) for a batch of keys in one native call; slot -1
         means the table is full for that key."""
-        n = len(keys)
-        blob = b"".join(keys)
-        offsets = np.zeros(n + 1, np.int64)
-        np.cumsum([len(k) for k in keys], out=offsets[1:])
-        return self.resolve_blob(blob, offsets)
+        from gubernator_tpu.ops.reqcols import pack_blob
+
+        return self.resolve_blob(*pack_blob(keys))
 
     def resolve_blob(self, blob: bytes, offsets: np.ndarray):
         """resolve_batch on pre-packed (blob, offsets) — the columnar hot
@@ -174,29 +172,39 @@ class NativeSlotMap:
         slots = np.ascontiguousarray(slots, np.int64)
         self._lib.guber_slotmap_release_batch(self._h, slots, len(slots))
 
-    def keys_batch(self, slots: np.ndarray) -> List[bytes]:
-        """Keys of a batch of slots (b"" for unassigned) in one native call."""
+    def keys_blob(self, slots: np.ndarray) -> tuple[bytes, np.ndarray]:
+        """Keys of a batch of slots as one (blob, offsets) pair — the
+        columnar snapshot format; unassigned slots span zero bytes."""
         slots = np.ascontiguousarray(slots, np.int64)
         n = len(slots)
         offsets = np.zeros(n + 1, np.int64)
         cap = max(4096, n * 64)
         while True:
-            blob = ctypes.create_string_buffer(cap)
+            buf = ctypes.create_string_buffer(cap)
             need = self._lib.guber_slotmap_keys_batch(
-                self._h, slots, n, blob, cap, offsets
+                self._h, slots, n, buf, cap, offsets
             )
             if need <= cap:
                 break
             cap = int(need)
-        mv = memoryview(blob)  # slice without copying the whole buffer
-        return [bytes(mv[offsets[i] : offsets[i + 1]]) for i in range(n)]
+        return buf.raw[: offsets[n]], offsets
 
-    def assign_batch(self, keys: List[bytes]) -> np.ndarray:
-        """Assign a batch of keys in one native call; -1 = table full."""
-        n = len(keys)
-        blob = b"".join(keys)
-        offsets = np.zeros(n + 1, np.int64)
-        np.cumsum([len(k) for k in keys], out=offsets[1:])
+    def keys_batch(self, slots: np.ndarray) -> List[bytes]:
+        """Keys of a batch of slots (b"" for unassigned) in one native call."""
+        blob, offsets = self.keys_blob(slots)
+        mv = memoryview(blob)  # slice without copying the whole buffer
+        return [bytes(mv[offsets[i] : offsets[i + 1]]) for i in range(len(slots))]
+
+    def assign_blob(self, blob: bytes, offsets: np.ndarray) -> np.ndarray:
+        """Assign keys packed as (blob, offsets); -1 = table full."""
+        n = len(offsets) - 1
+        offsets = np.ascontiguousarray(offsets, np.int64)
         out = np.empty(n, np.int64)
         self._lib.guber_slotmap_assign_batch(self._h, blob, offsets, n, out)
         return out
+
+    def assign_batch(self, keys: List[bytes]) -> np.ndarray:
+        """Assign a batch of keys in one native call; -1 = table full."""
+        from gubernator_tpu.ops.reqcols import pack_blob
+
+        return self.assign_blob(*pack_blob(keys))

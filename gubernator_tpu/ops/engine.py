@@ -47,7 +47,7 @@ from gubernator_tpu.ops.buckets import (
     scatter_state,
 )
 from gubernator_tpu.ops import rowtable
-from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns
+from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns, compact_blob
 from gubernator_tpu.ops.rowtable import RowState
 from gubernator_tpu.types import (
     Algorithm,
@@ -825,6 +825,44 @@ READBACK_ROWS = (
 )
 
 
+# Columnar snapshot schema: every stored bucket field as a (live,) array
+# plus the key blob/offsets pair.  The Loader v2 wire format.
+SNAP_FIELDS = (
+    "algorithm", "limit", "remaining", "remaining_f", "duration",
+    "created_at", "updated_at", "burst", "status", "expire_at",
+)
+
+
+def snapshot_from_items(items: Sequence[dict]) -> dict:
+    """Loader-contract item dicts → columnar snapshot (the inverse of
+    :func:`items_from_snapshot`; the one place the dict→columns
+    conversion lives)."""
+    from gubernator_tpu.ops.reqcols import pack_blob
+
+    blob, offsets = pack_blob([it["key"].encode() for it in items])
+    snap: dict = {"key_blob": blob, "key_offsets": offsets}
+    for f in SNAP_FIELDS:
+        dt = np.float64 if f == "remaining_f" else np.int64
+        snap[f] = np.asarray([it[f] for it in items], dt)
+    return snap
+
+
+def items_from_snapshot(snap: dict) -> List[dict]:
+    """Columnar snapshot → Loader-contract item dicts (the dict API edge;
+    per-item Python lives only here)."""
+    offsets = snap["key_offsets"]
+    blob = snap["key_blob"]
+    n = len(offsets) - 1
+    cols = {f: snap[f].tolist() for f in SNAP_FIELDS}
+    keys = [
+        bytes(blob[offsets[j] : offsets[j + 1]]).decode() for j in range(n)
+    ]
+    return [
+        {"key": keys[j], **{f: cols[f][j] for f in SNAP_FIELDS}}
+        for j in range(n)
+    ]
+
+
 def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
     """Build Loader-contract item dicts for the live slots of a (host) state.
 
@@ -1016,6 +1054,18 @@ class SlotMap:
             for s in slots
         ]
 
+    def keys_blob(self, slots: np.ndarray) -> tuple[bytes, np.ndarray]:
+        """Keys of a batch of slots as one (blob, offsets) pair (the
+        columnar snapshot format; NativeSlotMap does this natively)."""
+        from gubernator_tpu.ops.reqcols import pack_blob
+
+        return pack_blob(self.keys_batch(slots))
+
+    def assign_blob(self, blob: bytes, offsets: np.ndarray) -> np.ndarray:
+        return self.assign_batch(
+            [blob[offsets[j] : offsets[j + 1]] for j in range(len(offsets) - 1)]
+        )
+
     def assign_batch(self, keys: List[bytes]) -> np.ndarray:
         out = np.empty(len(keys), np.int64)
         for j, k in enumerate(keys):
@@ -1039,12 +1089,26 @@ def _jitted_dead_scan():
     return jax.jit(scan)
 
 
+def device_dead_bits(in_use, expire_field, now: int):
+    """Dispatch the dead-slot scan; returns the *device* packed bitmask
+    (callers materialize with :func:`unpack_dead_bits`).  Split from
+    :func:`device_dead_mask` so the background reclaimer can dispatch
+    under the engine lock (the state buffers are donated by the next tick)
+    but pay the D2H wait outside it."""
+    lo, hi = expire_field
+    return _jitted_dead_scan()(in_use, lo, hi, jnp.int64(now))
+
+
+def unpack_dead_bits(bits, capacity: int) -> np.ndarray:
+    return np.unpackbits(
+        np.asarray(bits), count=capacity, bitorder="little"
+    ).astype(bool)
+
+
 def device_dead_mask(in_use, expire_field, now: int, capacity: int) -> np.ndarray:
     """Host bool mask of device-dead slots (unused or TTL-expired), computed
     on device and shipped as a packed bitmask."""
-    lo, hi = expire_field
-    bits = np.asarray(_jitted_dead_scan()(in_use, lo, hi, jnp.int64(now)))
-    return np.unpackbits(bits, count=capacity, bitorder="little").astype(bool)
+    return unpack_dead_bits(device_dead_bits(in_use, expire_field, now), capacity)
 
 
 def select_reclaim_victims(
@@ -1138,20 +1202,53 @@ class TickHandle:
         self._slots_req = slots_req
         self._done: Optional[np.ndarray] = None
 
+    def _finish(self, raw: np.ndarray) -> None:
+        """Complete from an already-materialized (5, W) response matrix."""
+        if self._done is not None:
+            return
+        # The [:, inv] un-permutes the slot-sorted batch.
+        rm = raw[:, : self._n][:, self._inv]
+        eng = self._engine
+        with eng._lock:
+            eng.metric_over_limit += int(rm[4].sum())
+            if eng.store is not None:
+                eng._write_through(
+                    self._refs, self._slots_req, self._n, self.errors
+                )
+        self._resp = None  # release the device buffer reference
+        self._done = rm
+
     def result(self) -> tuple[np.ndarray, Dict[int, str]]:
         if self._done is None:
-            # One D2H; the [:, inv] un-permutes the slot-sorted batch.
-            rm = np.asarray(self._resp)[:, : self._n][:, self._inv]
-            eng = self._engine
-            with eng._lock:
-                eng.metric_over_limit += int(rm[4].sum())
-                if eng.store is not None:
-                    eng._write_through(
-                        self._refs, self._slots_req, self._n, self.errors
-                    )
-            self._resp = None  # release the device buffer reference
-            self._done = rm
+            self._finish(np.asarray(self._resp))
         return self._done, self.errors
+
+
+def resolve_ticks(handles: Sequence[TickHandle]) -> None:
+    """Materialize many dispatched ticks' responses in as few D2H
+    transfers as possible: same-shape response buffers are stacked on
+    device (a cheap async op) and fetched in ONE host transfer.
+
+    Per-transfer latency is the throughput ceiling when the device is far
+    away (measured here: ~3 ms to dispatch a tick, ~130 ms for EACH
+    response transfer over the tunneled device — so resolving K ticks
+    together is a ~K× throughput lever; on local PCIe/ICI it merely saves
+    K-1 small syscalls)."""
+    todo = [h for h in handles if h._done is None]
+    if len(todo) <= 1:
+        for h in todo:
+            h.result()
+        return
+    groups: Dict[tuple, List[TickHandle]] = {}
+    for h in todo:
+        groups.setdefault(tuple(h._resp.shape), []).append(h)
+    for hs in groups.values():
+        if len(hs) == 1:
+            hs[0].result()
+            continue
+        stacked = np.asarray(jnp.stack([h._resp for h in hs]))
+        for k, h in enumerate(hs):
+            h._finish(stacked[k])
 
 
 class SubmittedBatch:
@@ -1165,7 +1262,11 @@ class SubmittedBatch:
         self._spans = spans
         self._n = n
 
+    def handles(self) -> List[TickHandle]:
+        return self._handles
+
     def responses(self) -> List[RateLimitResponse]:
+        resolve_ticks(self._handles)  # one D2H for all chunks
         out: List[Optional[RateLimitResponse]] = [None] * self._n
         for h, (s, e) in zip(self._handles, self._spans):
             rm, errors = h.result()
@@ -1198,6 +1299,7 @@ class TickEngine:
         device: Optional[jax.Device] = None,
         store=None,
         table_layout: str = "auto",
+        bg_reclaim: Optional[bool] = None,
     ):
         self.capacity = int(capacity)
         self.max_batch = int(max_batch)
@@ -1234,6 +1336,28 @@ class TickEngine:
         self._pending: set = set()
         self._tick_count = 0
         self._lock = threading.RLock()
+        # Background reclaim (SURVEY §7 "reclaim off the serving path"):
+        # when free slots dip under the low watermark AND the batch had
+        # misses, a reclaimer thread runs TTL-then-LRU victim selection on
+        # snapshots outside the lock, so a full 10M-slot table doesn't put
+        # an argpartition + dead-scan D2H on the p99 of a serving tick.
+        # Auto-enabled for big tables only: small tables keep the strict
+        # evict-at-capacity semantics (reference lrucache.go:138-149) that
+        # the behavior suite pins, and the sync fallback still guarantees
+        # progress when the reclaimer is behind.
+        self._bg_reclaim = (
+            bg_reclaim if bg_reclaim is not None else self.capacity >= (1 << 18)
+        )
+        self._reclaim_low = min(
+            self.capacity // 8, max(2 * self.max_batch, self.capacity // 64)
+        )
+        self._reclaim_evt = threading.Event()
+        self._reclaim_closed = False
+        self._reclaim_thread: Optional[threading.Thread] = None
+        # Request-time clock: the max `now` any tick has seen.  Background
+        # reclaim judges TTL expiry against THIS, not the wall clock —
+        # callers may drive synthetic time (tests, replay harnesses).
+        self._last_now = 0
         # Metrics mirrors (lrucache.go:48-59, gubernator.go:60-111 families).
         self.metric_hits = 0
         self.metric_misses = 0
@@ -1266,14 +1390,14 @@ class TickEngine:
         self._dead_mask(0)
         jax.block_until_ready(self.state)
 
-    def _dead_mask(self, now: int) -> np.ndarray:
+    def _dead_bits(self, now: int):
+        """Dispatch the device dead-slot scan (packed bitmask, on device)."""
         if self.layout == "row":
-            return rowtable.row_device_dead_mask(
-                self.state, now, self.capacity
-            )
-        return device_dead_mask(
-            self.state.in_use, self.state.expire_at, now, self.capacity
-        )
+            return rowtable.row_device_dead_bits(self.state, now)
+        return device_dead_bits(self.state.in_use, self.state.expire_at, now)
+
+    def _dead_mask(self, now: int) -> np.ndarray:
+        return unpack_dead_bits(self._dead_bits(now), self.capacity)
 
     # ------------------------------------------------------------------
     # Host-side request preparation
@@ -1312,6 +1436,100 @@ class TickEngine:
         self.metric_unexpired_evictions += len(victims)
         self.slots.release_batch(victims)
         self.state = evict_chunked(self._evict, self.state, victims, self.capacity)
+
+    # ------------------------------------------------------------------
+    # Background reclaim
+    # ------------------------------------------------------------------
+    def _maybe_trigger_reclaim(self) -> None:
+        """Wake the reclaimer when free slots dip under the watermark.
+        Called under the lock from the build path, only when the batch had
+        misses — a full table under pure-hit traffic must NOT evict (the
+        reference evicts on insert pressure only, lrucache.go:88-103)."""
+        if not self._bg_reclaim or self._reclaim_closed:
+            return
+        if self.capacity - len(self.slots) >= self._reclaim_low:
+            return
+        if self._reclaim_thread is None:  # lazy: most engines never need it
+            self._reclaim_thread = threading.Thread(
+                target=self._reclaim_loop, daemon=True, name="guber-reclaim"
+            )
+            self._reclaim_thread.start()
+        self._reclaim_evt.set()
+
+    def _reclaim_loop(self) -> None:
+        import logging
+
+        while True:
+            self._reclaim_evt.wait()
+            self._reclaim_evt.clear()
+            if self._reclaim_closed:
+                return
+            try:
+                self._reclaim_background()
+            except Exception:
+                logging.getLogger("gubernator.engine").exception(
+                    "background reclaim failed"
+                )
+
+    def _reclaim_background(self) -> None:
+        """One reclaim round with the expensive work off the lock.
+
+        Phase 1 (lock): *dispatch* the device dead-scan — must happen under
+        the lock because the next tick donates the state buffers.  Expiry
+        is judged against the engine's request-time clock (``_last_now``),
+        NOT the host wall clock: callers may drive synthetic time (tests,
+        replay), and the reference's expiry is always relative to request
+        ``CreatedAt`` (algorithms.go:46-57).
+        Phase 2 (no lock): materialize the dead bitmask (D2H wait).
+        Phase 3 (lock): snapshot mapped/pending/last_access.
+        Phase 4 (no lock): TTL-then-LRU victim selection (argpartition over
+        the table — the cost that used to spike serving p99).
+        Phase 5 (lock): revalidate — drop any candidate touched since the
+        snapshot (later builds stamp tick_count > snap under the lock) —
+        then release slots and dispatch the evict scatter (async).
+        """
+        with self._lock:
+            # Size the round to the watermark deficit (target: 2x the low
+            # watermark free, capped at the sync quantum) — the trigger
+            # may have been satisfied already by an earlier round.
+            free = self.capacity - len(self.slots)
+            want = min(self.capacity // 16, 2 * self._reclaim_low - free)
+            if want <= 0 or self._last_now == 0:
+                return
+            # snap is taken HERE, before the scan is dispatched: the dead
+            # bitmask is stale for anything that ticks during the D2H
+            # wait, and the phase-5 `la <= snap` filter must therefore
+            # drop every slot touched at tick > snap — a bucket revived
+            # mid-wait must not be freed on the strength of the old scan.
+            snap = self._tick_count
+            bits = self._dead_bits(self._last_now)
+        dead = unpack_dead_bits(bits, self.capacity)
+        with self._lock:
+            mapped = self.slots.mapped_mask()
+            if self._pending:
+                mapped[np.fromiter(self._pending, np.int64)] = False
+            la = self._last_access.copy()
+        freed, victims = select_reclaim_victims(mapped, dead, la, snap, want)
+        with self._lock:
+            freed = freed[self._last_access[freed] <= snap]
+            victims = victims[self._last_access[victims] <= snap]
+            self.slots.release_batch(freed)
+            if len(victims):
+                self.metric_unexpired_evictions += len(victims)
+                self.slots.release_batch(victims)
+                self.state = evict_chunked(
+                    self._evict, self.state, victims, self.capacity
+                )
+
+    def close(self) -> None:
+        """Stop the background reclaimer.  Engines are otherwise GC-safe
+        (the thread is a daemon and lazily started); services close via
+        V1Instance.close."""
+        self._reclaim_closed = True
+        self._reclaim_evt.set()
+        t = self._reclaim_thread
+        if t is not None:
+            t.join(timeout=5)
 
     def _build_cols(self, cols: ReqColumns, now: int):
         """Resolve keys to slots and pack the padded (12, B) request matrix
@@ -1389,8 +1607,13 @@ class TickEngine:
         self._last_access[slots] = self._tick_count
         miss = known == 0
         self._pending.update(slots[miss].tolist())
-        self.metric_hits += int((~miss).sum())
-        self.metric_misses += int(miss.sum())
+        n_miss = int(miss.sum())
+        self.metric_hits += len(miss) - n_miss
+        self.metric_misses += n_miss
+        if n_miss:
+            # Insert pressure near a full table: reclaim in the background
+            # so the dead-scan/argpartition never lands on a serving tick.
+            self._maybe_trigger_reclaim()
 
         if self.store is not None and miss.any():
             if cols.refs is None:
@@ -1486,6 +1709,7 @@ class TickEngine:
         """
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
+            self._last_now = max(self._last_now, now)
             self._tick_count += 1
             packed, n, errors, inv = self._build_cols(cols, now)
             # Named range in XProf captures (utils/tracing.py): device
@@ -1526,6 +1750,7 @@ class TickEngine:
         handles = [
             self.submit_columns(cols.slice_chunk(s, e), now) for s, e in spans
         ]
+        resolve_ticks(handles)  # one D2H for the whole chunk pipeline
         out = np.empty((5, n), np.int64)
         errors: Dict[int, str] = {}
         for h, (s, e) in zip(handles, spans):
@@ -1665,61 +1890,98 @@ class TickEngine:
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
     # ------------------------------------------------------------------
-    def export_items(self) -> List[dict]:
-        """Drain live bucket state to host dicts (Loader.Save analog).
+    def export_columns(self) -> dict:
+        """Bulk snapshot: numpy columns + one key blob (the Loader v2
+        format; see SNAP_FIELDS).  O(1) Python calls regardless of table
+        size — one D2H of the table, one native key export, one vectorized
+        slice per column.  The reference streams items through a channel
+        (store.go:69-78); the columnar analog of that stream is arrays."""
+        from gubernator_tpu.ops.buckets import slice_field
 
-        One D2H of the table + one native key export + vectorized column
-        slicing; the per-item dict build is the only O(live) Python left
-        (the Loader contract is dict-shaped).
-        """
         with self._lock:
             if self.layout == "row":
                 st = rowtable.row_host_columns(self.state)
             else:
                 st = jax.tree.map(np.asarray, self.state)
             live = np.flatnonzero(self.slots.mapped_mask() & st.in_use)
-            if len(live) == 0:
-                return []
-            return items_from_columns(self.slots.keys_batch(live), st, live)
+            blob, offsets = self.slots.keys_blob(live)
+            snap: dict = {"key_blob": blob, "key_offsets": offsets}
+            for name in SNAP_FIELDS:
+                snap[name] = np.ascontiguousarray(
+                    np_logical(slice_field(getattr(st, name), live), name)
+                )
+            return snap
 
-    def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
-        """Install snapshot items into the table (Loader.Load analog).
+    def export_items(self) -> List[dict]:
+        """Drain live bucket state to host dicts (the dict-shaped Loader
+        API edge over :meth:`export_columns`)."""
+        return items_from_snapshot(self.export_columns())
 
-        One native batch-assign + one jitted scatter — no full-table
-        rewrite, so a restore can't clobber concurrent updates and scales
-        to the 10M-slot regime.
+    def load_columns(self, snap: dict, now: Optional[int] = None) -> None:
+        """Bulk restore from a columnar snapshot (see export_columns).
+
+        Expired rows are dropped with a vectorized blob compaction; one
+        native blob-assign maps every key; duplicate keys dedup to their
+        LAST occurrence (install order — the row layout's one-DMA-per-slot
+        contract); the data lands in RESTORE_CHUNK-wide jitted scatters.
         """
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
+            self._last_now = max(self._last_now, now)
             self._tick_count += 1  # see install_globals: unblock LRU reclaim
-            # Dedup by key (last wins): duplicate keys would resolve to one
-            # slot and race in the row layout's scatter (see install_globals).
-            live_by_key = {
-                it["key"]: it for it in items if it["expire_at"] >= now
-            }
-            live = list(live_by_key.values())
-            if not live:
+            offsets = np.asarray(snap["key_offsets"], np.int64)
+            n = len(offsets) - 1
+            if n == 0:
                 return
-            shortfall = len(self.slots) + len(live) - self.capacity
+            cols = {f: np.asarray(snap[f]) for f in SNAP_FIELDS}
+            blob = snap["key_blob"]
+            keep = cols["expire_at"] >= now
+            if not keep.all():
+                blob, offsets = compact_blob(blob, offsets, keep)
+                cols = {f: c[keep] for f, c in cols.items()}
+                n = int(keep.sum())
+                if n == 0:
+                    return
+            shortfall = len(self.slots) + n - self.capacity
             if shortfall > 0:
                 self._reclaim(now, want=shortfall)
-            slots = self.slots.assign_batch(
-                [it["key"].encode() for it in live]
-            )
-            ok = np.flatnonzero(slots >= 0)  # full table: drop the tail
-            if len(ok) == 0:
+            slots = self.slots.assign_blob(blob, offsets)
+            sel = np.flatnonzero(slots >= 0)  # full table: drop the tail
+            if len(sel) == 0:
                 return
-            self._last_access[slots[ok]] = self._tick_count
+            # Last-wins dedup by slot (same key → same slot): reverse +
+            # first-unique keeps each slot's final occurrence.
+            s = slots[sel]
+            _, ridx = np.unique(s[::-1], return_index=True)
+            sel = sel[len(s) - 1 - ridx]
+            self._last_access[slots[sel]] = self._tick_count
             # Chunked like evict_chunked: one restore per RESTORE_CHUNK
             # keeps the compiled width bounded — the row layout stages
             # the batch in VMEM (512 B/row), so a multi-million-item
             # snapshot in one call would not even compile.
-            for start in range(0, len(ok), RESTORE_CHUNK):
-                part = ok[start : start + RESTORE_CHUNK]
-                ints, floats = pack_restore_matrix(live, part, slots)
+            for start in range(0, len(sel), RESTORE_CHUNK):
+                part = sel[start : start + RESTORE_CHUNK]
+                k = len(part)
+                w = pad_pow2(k)
+                ints = np.zeros((len(ITEM_INT_ROWS), w), np.int64)
+                floats = np.zeros(w, np.float64)
+                ints[0, :k] = slots[part]
+                for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
+                    ints[r, :k] = cols[name][part]
+                ints[-1, :k] = 1  # valid
+                floats[:k] = cols["remaining_f"][part]
                 self.state = self._restore(
                     self.state, jnp.asarray(ints), jnp.asarray(floats)
                 )
+
+    def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
+        """Install snapshot items into the table (the dict-shaped Loader
+        API edge: one pass builds the columnar snapshot, then
+        :meth:`load_columns` does the real work)."""
+        items = list(items)
+        if not items:
+            return
+        self.load_columns(snapshot_from_items(items), now=now)
 
     def cache_size(self) -> int:
         return len(self.slots)

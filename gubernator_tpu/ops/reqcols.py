@@ -149,14 +149,39 @@ class ReqColumns:
         )
 
 
+def pack_blob(keys: Sequence[bytes]) -> tuple[bytes, np.ndarray]:
+    """Concatenate keys into the (blob, (n+1,) int64 offsets) wire format
+    every blob consumer here expects (native slotmap, snapshots)."""
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    return b"".join(keys), offsets
+
+
+def compact_blob(
+    blob: bytes, offsets: np.ndarray, keep: np.ndarray
+) -> tuple[bytes, np.ndarray]:
+    """Filter a (blob, offsets) key pack down to the keep-masked rows,
+    fully vectorized (snapshot restore drops expired rows without a
+    per-key Python loop)."""
+    arr = np.frombuffer(blob, np.uint8)
+    lens = np.diff(offsets)
+    starts = offsets[:-1][keep]
+    ls = lens[keep]
+    cum = np.zeros(len(ls) + 1, np.int64)
+    np.cumsum(ls, out=cum[1:])
+    pos = (
+        np.arange(int(cum[-1]), dtype=np.int64)
+        - np.repeat(cum[:-1], ls)
+        + np.repeat(starts, ls)
+    )
+    return arr[pos].tobytes(), cum
+
+
 def key_blob_from_parts(
     names: Sequence[str], unique_keys: Sequence[str]
 ) -> tuple[bytes, np.ndarray]:
     """Build (blob, offsets) for ``name_uniquekey`` hash keys from parallel
     name/key sequences (transport parse path)."""
-    keys = [
-        (nm + "_" + uk).encode() for nm, uk in zip(names, unique_keys)
-    ]
-    offsets = np.zeros(len(keys) + 1, np.int64)
-    np.cumsum([len(k) for k in keys], out=offsets[1:])
-    return b"".join(keys), offsets
+    return pack_blob(
+        [(nm + "_" + uk).encode() for nm, uk in zip(names, unique_keys)]
+    )

@@ -352,8 +352,14 @@ def _jitted_row_dead_scan():
     return jax.jit(scan)
 
 
+def row_device_dead_bits(state: RowState, now: int):
+    """Dispatch the dead-slot scan; returns the device packed bitmask (see
+    engine.device_dead_bits for the dispatch/materialize split)."""
+    return _jitted_row_dead_scan()(state.table, jnp.int64(now))
+
+
 def row_device_dead_mask(state: RowState, now: int, capacity: int) -> np.ndarray:
-    bits = np.asarray(_jitted_row_dead_scan()(state.table, jnp.int64(now)))
+    bits = np.asarray(row_device_dead_bits(state, now))
     return np.unpackbits(bits, count=capacity, bitorder="little").astype(bool)
 
 

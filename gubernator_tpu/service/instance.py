@@ -204,8 +204,16 @@ class V1Instance:
     async def create(cls, conf: InstanceConfig, engine=None) -> "V1Instance":
         inst = cls(conf, engine)
         if conf.loader is not None:
-            items = conf.loader.load()
-            inst.engine.load_items(list(items))
+            # Columnar Loaders (v2) restore without dict materialization.
+            if hasattr(conf.loader, "load_columns") and hasattr(
+                inst.engine, "load_columns"
+            ):
+                snap = conf.loader.load_columns()
+                if snap is not None:
+                    inst.engine.load_columns(snap)
+            else:
+                items = conf.loader.load()
+                inst.engine.load_items(list(items))
         return inst
 
     # ------------------------------------------------------------------
@@ -611,6 +619,13 @@ class V1Instance:
             except Exception:
                 pass
         if self.conf.loader is not None:
-            self.conf.loader.save(self.engine.export_items())
+            if hasattr(self.conf.loader, "save_columns") and hasattr(
+                self.engine, "export_columns"
+            ):
+                self.conf.loader.save_columns(self.engine.export_columns())
+            else:
+                self.conf.loader.save(self.engine.export_items())
         self.tick_loop.close()
+        if hasattr(self.engine, "close"):
+            self.engine.close()
         self.metrics.cache_size.set(self.engine.cache_size())

@@ -163,23 +163,50 @@ class TickLoop:
             item = self._resolve_q.get()
             if item is None:
                 return
-            sb, batch, reqs, dispatch_s = item
-            # Everything below is guarded: an exception escaping this loop
-            # would kill the resolver thread and wedge the whole pipeline
-            # (dispatch eventually blocks on the bounded queue forever).
+            # Drain whatever else is queued: all drained windows resolve
+            # with ONE device-to-host transfer (engine.resolve_ticks) —
+            # per-transfer latency is the throughput ceiling when the
+            # device is remote, so the resolver never fetches one window
+            # at a time when several are in flight.
+            items = [item]
+            stop = False
+            while True:
+                try:
+                    nxt = self._resolve_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                items.append(nxt)
             try:
-                t1 = time.perf_counter()
-                out = sb.responses()
-                resolve_s = time.perf_counter() - t1
-            except Exception as e:
-                _fail_waiters(batch, e)
-                continue
-            try:
-                self._deliver(batch, reqs, out, dispatch_s + resolve_s)
-            except Exception:
-                logging.getLogger("gubernator.tickloop").exception(
-                    "tick delivery failed"
+                from gubernator_tpu.ops.engine import resolve_ticks
+
+                resolve_ticks(
+                    [h for sb, _, _, _ in items for h in sb.handles()]
                 )
+            except Exception:
+                pass  # per-window responses() below surfaces real errors
+            for sb, batch, reqs, dispatch_s in items:
+                # Everything below is guarded: an exception escaping this
+                # loop would kill the resolver thread and wedge the whole
+                # pipeline (dispatch eventually blocks on the bounded
+                # queue forever).
+                try:
+                    t1 = time.perf_counter()
+                    out = sb.responses()
+                    resolve_s = time.perf_counter() - t1
+                except Exception as e:
+                    _fail_waiters(batch, e)
+                    continue
+                try:
+                    self._deliver(batch, reqs, out, dispatch_s + resolve_s)
+                except Exception:
+                    logging.getLogger("gubernator.tickloop").exception(
+                        "tick delivery failed"
+                    )
+            if stop:
+                return
 
     def _deliver(self, batch, reqs, out, tick_s: float) -> None:
         """Complete the waiters' futures + sync metrics.  ``tick_s`` is the

@@ -114,3 +114,55 @@ class FileLoader:
             for it in items:
                 f.write(json.dumps(it) + "\n")
         os.replace(tmp, self.path)
+
+
+class ColumnLoader(Protocol):
+    """Bulk-snapshot Loader (v2): whole-table numpy columns + key blob
+    instead of per-item dicts.  The engine detects this protocol and skips
+    dict materialization entirely — at 10M items that is seconds instead
+    of minutes.  See engine.SNAP_FIELDS for the schema."""
+
+    def load_columns(self) -> Optional[dict]: ...
+
+    def save_columns(self, snap: dict) -> None: ...
+
+
+class ColumnFileLoader:
+    """NPZ columnar snapshot Loader — the durable form of the v2 bulk
+    format (and, via load()/save(), also a valid dict Loader for engines
+    that don't speak columns)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load_columns(self) -> Optional[dict]:
+        import numpy as np
+
+        if not os.path.exists(self.path):
+            return None
+        with np.load(self.path) as z:
+            snap = {k: z[k] for k in z.files}
+        snap["key_blob"] = snap["key_blob"].tobytes()
+        return snap
+
+    def save_columns(self, snap: dict) -> None:
+        import numpy as np
+
+        tmp = self.path + ".tmp.npz"
+        enc = dict(snap)
+        enc["key_blob"] = np.frombuffer(snap["key_blob"], np.uint8)
+        with open(tmp, "wb") as f:
+            np.savez(f, **enc)
+        os.replace(tmp, self.path)
+
+    # Dict-protocol compatibility (Loader): columnar on disk either way.
+    def load(self) -> Iterable[dict]:
+        from gubernator_tpu.ops.engine import items_from_snapshot
+
+        snap = self.load_columns()
+        return [] if snap is None else items_from_snapshot(snap)
+
+    def save(self, items: Iterable[dict]) -> None:
+        from gubernator_tpu.ops.engine import snapshot_from_items
+
+        self.save_columns(snapshot_from_items(list(items)))

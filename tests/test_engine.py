@@ -158,3 +158,68 @@ def test_reclaim_frees_enough_for_whole_batch():
     assert eng.metric_unexpired_evictions >= 128
     # Evicted state must not resurrect on slot reuse.
     assert eng.process([req("c0")], now=now)[0].remaining == 9
+
+
+def test_background_reclaim_keeps_table_under_watermark():
+    """With bg_reclaim forced on, sustained insert pressure near capacity
+    is absorbed by the reclaimer thread: allocations keep succeeding, LRU
+    evictions happen, and the sync fallback path stays available."""
+    import time
+
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    now = 1_700_000_000_000
+    eng = TickEngine(capacity=512, max_batch=64, bg_reclaim=True)
+    try:
+
+        def req(k):
+            return RateLimitRequest(name="n", unique_key=k, hits=1,
+                                    limit=10, duration=3_600_000)
+
+        # Flood with fresh keys well past capacity.
+        for start in range(0, 2048, 64):
+            rs = eng.process(
+                [req(f"f{start + i}") for i in range(64)], now=now
+            )
+            assert all(r.error == "" for r in rs)
+        # Give the reclaimer a beat, then keep inserting: still no errors.
+        time.sleep(0.2)
+        rs = eng.process([req(f"tail{i}") for i in range(64)], now=now)
+        assert all(r.error == "" for r in rs)
+        assert eng.metric_unexpired_evictions > 0
+        assert eng.cache_size() <= 512
+    finally:
+        eng.close()
+
+
+def test_background_reclaim_no_evictions_without_watermark_pressure():
+    """The reclaimer only wakes when free slots dip under the watermark
+    AND a batch had misses; a table holding above the watermark never
+    evicts, however hot the traffic (the reference evicts on insert
+    pressure only, lrucache.go:88-103)."""
+    import time
+
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    now = 1_700_000_000_000
+    # watermark = min(128//8, max(2*64, 2)) = 16 free slots
+    eng = TickEngine(capacity=128, max_batch=64, bg_reclaim=True)
+    try:
+
+        def req(k):
+            return RateLimitRequest(name="n", unique_key=k, hits=1,
+                                    limit=1000, duration=3_600_000)
+
+        fill = [req(f"k{i}") for i in range(100)]  # free = 28 > watermark
+        eng.process(fill[:64], now=now)
+        eng.process(fill[64:], now=now)
+        for t in range(5):  # pure hits on a comfortably-full table
+            eng.process(fill[:64], now=now + t)
+        time.sleep(0.2)
+        assert eng.metric_unexpired_evictions == 0
+        assert eng._reclaim_thread is None  # never even started
+        assert eng.cache_size() == 100
+    finally:
+        eng.close()
