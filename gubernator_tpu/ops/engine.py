@@ -896,25 +896,6 @@ def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
     ]
 
 
-def pack_restore_matrix(items: Sequence[dict], ok: np.ndarray, slots: np.ndarray):
-    """Pack snapshot items into the ``make_restore_fn`` input matrices.
-
-    ``ok`` selects the rows of ``items``/``slots`` that got a slot; returns
-    ``(ints, floats)`` padded to a power-of-two width so restore compiles a
-    handful of shapes.
-    """
-    n = len(ok)
-    w = pad_pow2(n)
-    ints = np.zeros((len(ITEM_INT_ROWS), w), np.int64)
-    floats = np.zeros(w, np.float64)
-    ints[0, :n] = slots[ok]
-    for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
-        ints[r, :n] = [items[j][name] for j in ok]
-    ints[-1, :n] = 1  # valid
-    floats[:n] = [items[j]["remaining_f"] for j in ok]
-    return ints, floats
-
-
 def make_evict_fn(layout: str = "columns"):
     """Jitted slot eviction: zero a batch of slots (LRU reclamation).
 

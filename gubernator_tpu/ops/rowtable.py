@@ -390,3 +390,19 @@ def row_host_columns(state: RowState) -> BucketState:
         return tuple(cols[o + k] for k in range(n))
 
     return BucketState(**{f: stored(f) for f in STATE_DTYPES})
+
+
+def host_columns_from_rows(rows: np.ndarray) -> BucketState:
+    """Host-side stored-layout BucketState from an (N, ROW_W) matrix of
+    *data* rows (guard rows already dropped) — the mesh engine's export
+    path, where the sharded table is fetched whole."""
+
+    def stored(f):
+        o = FIELD_OFFSETS[f]
+        n = _field_words(f)
+        if n == 1:
+            c = np.ascontiguousarray(rows[:, o])
+            return c.astype(bool) if STATE_DTYPES[f] == jnp.bool_ else c
+        return tuple(np.ascontiguousarray(rows[:, o + k]) for k in range(n))
+
+    return BucketState(**{f: stored(f) for f in STATE_DTYPES})

@@ -1,7 +1,7 @@
 from gubernator_tpu.parallel.mesh_engine import (
     MeshTickEngine,
+    ShardedOps,
     make_mesh,
-    make_sharded_tick_fn,
 )
 
-__all__ = ["MeshTickEngine", "make_mesh", "make_sharded_tick_fn"]
+__all__ = ["MeshTickEngine", "ShardedOps", "make_mesh"]

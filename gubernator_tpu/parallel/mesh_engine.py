@@ -12,9 +12,18 @@ per shard — ``(n_shards, ROWS, B)`` — so a tick under ``shard_map`` is pure
 data-parallel SPMD: every device gathers/updates only its own shard.  This
 mirrors the reference's "no mutexes, keys statically routed to workers"
 design, with devices in place of goroutines.  Collectives (``psum`` etc.)
-enter only on the GLOBAL-behavior reconciliation path (landing with the
-GLOBAL manager), matching how the reference keeps its hot loop local and
+enter only on the GLOBAL-behavior reconciliation path (the GLOBAL mesh
+engine), matching how the reference keeps its hot loop local and
 reconciles asynchronously (``global.go``).
+
+Every device-side operation — tick, evict, install, restore, readback —
+runs as the same per-shard blocked ``shard_map``: the host builds one
+block per shard (padding rows aim at the shard's local guard/sentinel) and
+each device applies its block to its own slice.  Because the blocks reuse
+the single-chip ops (`make_tick_fn` etc.) per shard, the mesh engine
+supports BOTH table layouts: the int32-column SoA and the Pallas
+row-DMA layout (rowtable.py) — the row layout's ~6-8x tick speedup is not
+forfeited by going multi-chip.
 
 Why not route on-device (all-to-all)?  Keys are strings; hashing and the
 key→slot map live on the host anyway (SURVEY.md §7 "Host/device split"), so
@@ -26,7 +35,7 @@ from __future__ import annotations
 
 import threading
 import zlib
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -34,22 +43,30 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gubernator_tpu.ops import rowtable
 from gubernator_tpu.ops.buckets import BucketState, np_logical, slice_field
 from gubernator_tpu.ops.engine import (
+    EVICT_CHUNK,
+    ITEM_INT_ROWS,
+    READBACK_ROWS,
     REQ_ROWS,
     REQ_ROW_INDEX,
+    RESTORE_CHUNK,
+    SNAP_FIELDS,
     device_dead_mask,
-    evict_chunked,
     items_from_columns,
     make_evict_fn,
     make_install_fn,
+    make_layout_choice,
+    make_readback_fn,
     make_restore_fn,
     make_tick_fn,
     pack_request_matrix,
-    pack_restore_matrix,
     pad_pow2,
     resolve_gregorian,
+    select_reclaim_victims,
 )
+from gubernator_tpu.ops.rowtable import ROW_W, RowState
 from gubernator_tpu.types import GlobalUpdate, RateLimitRequest, RateLimitResponse
 from gubernator_tpu.utils import timeutil
 
@@ -60,39 +77,127 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), ("shard",))
 
 
-def make_sharded_tick_fn(mesh: Mesh, local_capacity: int):
-    """Build the sharded tick: (state, reqs, now) → (state, responses).
+class ShardedOps:
+    """The per-shard blocked device ops for one (mesh, local_capacity,
+    layout): tick/evict/install/restore/readback, each a shard_map of the
+    corresponding single-chip op, jitted with state donation."""
 
-    ``state`` arrays are length ``n_shards * local_capacity``, sharded along
-    axis 0; ``reqs`` is ``(n_shards, len(REQ_ROWS), B)`` with block *d*
-    holding requests whose **local** slot ids target shard *d* (padding rows
-    carry slot == local_capacity and valid == 0).  Responses come back as
-    ``(n_shards, 5, B)``; the host reassembles request order.
-    """
-    local_tick = make_tick_fn(local_capacity)
+    def __init__(self, mesh: Mesh, local_capacity: int, layout: str):
+        self.mesh = mesh
+        self.layout = layout
+        self.local_capacity = local_capacity
+        n = mesh.devices.size
 
-    def _local(state_blk: BucketState, req_blk: jnp.ndarray, now: jnp.ndarray):
-        new_state, resp = local_tick(state_blk, req_blk[0], now)
-        return new_state, resp[None]
+        if layout == "row":
+            # Each shard's block is its own (local_cap+1, ROW_W) row table
+            # — per-shard guard rows included, so local slot arithmetic
+            # inside the block is identical to the single-chip engine's.
+            state_spec = RowState(table=P("shard", None))
 
-    state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
-    return shard_map(
-        _local,
-        mesh=mesh,
-        in_specs=(state_spec, P("shard", None, None), P()),
-        out_specs=(state_spec, P("shard", None, None)),
-        check_vma=False,
-    )
+            def zeros_global():
+                return RowState(
+                    table=jnp.zeros((n * (local_capacity + 1), ROW_W), jnp.int32)
+                )
+        else:
+            state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
+
+            def zeros_global():
+                return BucketState.zeros(n * local_capacity)
+
+        self.state_spec = state_spec
+        self.state_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), state_spec
+        )
+        self.zeros_global = zeros_global
+        self.block_sharding2 = NamedSharding(mesh, P("shard", None))
+        self.block_sharding3 = NamedSharding(mesh, P("shard", None, None))
+
+        tick = make_tick_fn(local_capacity, layout=layout)
+        evict = make_evict_fn(layout)
+        install = make_install_fn(layout)
+        restore = make_restore_fn(layout)
+        readback = make_readback_fn(layout)
+
+        def smap(fn, in_specs, out_specs):
+            return jax.jit(
+                shard_map(
+                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+
+        def _tick(state_blk, req_blk, now):
+            st, resp = tick(state_blk, req_blk[0], now)
+            return st, resp[None]
+
+        self.tick = smap(
+            _tick,
+            (state_spec, P("shard", None, None), P()),
+            (state_spec, P("shard", None, None)),
+        )
+
+        def _evict(state_blk, slots_blk):
+            return evict(state_blk, slots_blk[0])
+
+        self.evict = smap(
+            _evict, (state_spec, P("shard", None)), state_spec
+        )
+
+        def _install(state_blk, cols_blk, now):
+            return install(state_blk, cols_blk[0], now)
+
+        self.install = smap(
+            _install, (state_spec, P("shard", None, None), P()), state_spec
+        )
+
+        def _restore(state_blk, ints_blk, floats_blk):
+            return restore(state_blk, ints_blk[0], floats_blk[0])
+
+        self.restore = smap(
+            _restore,
+            (state_spec, P("shard", None, None), P("shard", None)),
+            state_spec,
+        )
+
+        def _readback(state_blk, slots_blk):
+            ints, floats = readback(state_blk, slots_blk[0])
+            return ints[None], floats[None]
+
+        # No donation: readback is a pure gather.
+        self.readback = jax.jit(
+            shard_map(
+                _readback,
+                mesh=mesh,
+                in_specs=(state_spec, P("shard", None)),
+                out_specs=(P("shard", None, None), P("shard", None)),
+                check_vma=False,
+            )
+        )
+
+    def init_state(self):
+        return jax.tree.map(
+            lambda a, sh: jax.device_put(a, sh),
+            self.zeros_global(),
+            self.state_shardings,
+        )
+
+    def put2(self, blk: np.ndarray):
+        return jax.device_put(blk, self.block_sharding2)
+
+    def put3(self, blk: np.ndarray):
+        return jax.device_put(blk, self.block_sharding3)
 
 
 class MeshTickEngine:
     """Host driver for the sharded table (multi-chip WorkerPool analog).
 
-    Same contract as :class:`gubernator_tpu.ops.engine.TickEngine` but the
-    table lives sharded across ``mesh``; total capacity is
-    ``n_shards * local_capacity``.  Key→shard routing reuses the engine's
-    slot allocator: global slot ``g`` lives on shard ``g // local_capacity``
-    at local offset ``g % local_capacity``.
+    Same contract as :class:`gubernator_tpu.ops.engine.TickEngine` — row or
+    column layout, optional Store write/read-through — but the table lives
+    sharded across ``mesh``; total capacity is ``n_shards * local_capacity``.
+    Key→shard routing reuses the engine's slot allocator: global slot ``g``
+    lives on shard ``g // local_capacity`` at local offset
+    ``g % local_capacity``.
     """
 
     def __init__(
@@ -100,6 +205,8 @@ class MeshTickEngine:
         mesh: Optional[Mesh] = None,
         local_capacity: int = 1 << 14,
         max_batch: int = 1024,
+        store=None,
+        table_layout: str = "auto",
     ):
         from gubernator_tpu.ops.engine import make_slot_map
 
@@ -108,23 +215,13 @@ class MeshTickEngine:
         self.local_capacity = int(local_capacity)
         self.capacity = self.n_shards * self.local_capacity
         self.max_batch = int(max_batch)
-
-        state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
-        self._state_shardings = jax.tree.map(
-            lambda spec: NamedSharding(self.mesh, spec), state_spec
+        self.store = store
+        self.layout = make_layout_choice(
+            table_layout, self.local_capacity,
+            self.mesh.devices.flat[0], self.max_batch,
         )
-        self.state: BucketState = jax.tree.map(
-            lambda a, sh: jax.device_put(a, sh),
-            BucketState.zeros(self.capacity),
-            self._state_shardings,
-        )
-        self._tick = jax.jit(
-            make_sharded_tick_fn(self.mesh, self.local_capacity),
-            donate_argnums=(0,),
-        )
-        self._evict = jax.jit(make_evict_fn(), donate_argnums=(0,))
-        self._install = jax.jit(make_install_fn(), donate_argnums=(0,))
-        self._restore = jax.jit(make_restore_fn(), donate_argnums=(0,))
+        self.ops = ShardedOps(self.mesh, self.local_capacity, self.layout)
+        self.state = self.ops.init_state()
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
         # (workers.go:180-184).
@@ -136,30 +233,47 @@ class MeshTickEngine:
         self._pending: set = set()
         self._tick_count = 0
         self._lock = threading.RLock()
+        self.metric_hits = 0
+        self.metric_misses = 0
         self.metric_over_limit = 0
+        self.metric_unexpired_evictions = 0
         self._warmup()
 
     def _warmup(self) -> None:
         """Compile the sharded tick at startup (see TickEngine._warmup)."""
         m = np.zeros((self.n_shards, len(REQ_ROWS), self.max_batch), np.int64)
         m[:, REQ_ROW_INDEX["slot"], :] = self.local_capacity
-        reqs_dev = jax.device_put(
-            m, NamedSharding(self.mesh, P("shard", None, None))
+        self.state, resp = self.ops.tick(
+            self.state, self.ops.put3(m), jnp.int64(0)
         )
-        self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(0))
         np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
-        cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
-        self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
-        # Pre-compile the per-shard reclaim dead-scan (see TickEngine._warmup).
-        sl = slice(0, self.local_capacity)
-        device_dead_mask(
-            self.state.in_use[sl], slice_field(self.state.expire_at, sl),
-            0, self.local_capacity,
+        cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
+        self.state = self.ops.install(
+            self.state, self.ops.put3(cols), jnp.int64(0)
         )
+        # Pre-compile the per-shard reclaim dead-scan (see TickEngine).
+        self._shard_dead_mask(0, 0)
         jax.block_until_ready(self.state)
 
+    # ------------------------------------------------------------------
+    # Shard routing / reclamation
+    # ------------------------------------------------------------------
     def _shard_of(self, key: str) -> int:
         return zlib.crc32(key.encode()) % self.n_shards
+
+    def _shard_dead_mask(self, shard: int, now: int) -> np.ndarray:
+        """Device-dead mask for one shard's slice of the table."""
+        if self.layout == "row":
+            lo = shard * (self.local_capacity + 1)
+            return rowtable.row_device_dead_mask(
+                RowState(table=self.state.table[lo : lo + self.local_capacity + 1]),
+                now, self.local_capacity,
+            )
+        sl = slice(shard * self.local_capacity, (shard + 1) * self.local_capacity)
+        return device_dead_mask(
+            self.state.in_use[sl], slice_field(self.state.expire_at, sl),
+            now, self.local_capacity,
+        )
 
     def _resolve(self, key: str, shard: int, now: int) -> tuple[Optional[int], bool]:
         """(global slot, known) for key within its shard, reclaiming if
@@ -184,8 +298,6 @@ class MeshTickEngine:
         """Free expired slots in one shard; fall back to LRU eviction —
         the shared TTL/LRU policy (engine.select_reclaim_victims) over this
         shard's slice of the table."""
-        from gubernator_tpu.ops.engine import select_reclaim_victims
-
         sm = self.slots[shard]
         lo = shard * self.local_capacity
         mapped = sm.mapped_mask()
@@ -193,26 +305,32 @@ class MeshTickEngine:
             pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
             if pend:
                 mapped[np.asarray(pend, np.int64)] = False
-        sl = slice(lo, lo + self.local_capacity)
         freed, victims = select_reclaim_victims(
             mapped,
-            device_dead_mask(
-                self.state.in_use[sl],
-                slice_field(self.state.expire_at, sl),
-                now, self.local_capacity,
-            ),
-            self._last_access[sl],
+            self._shard_dead_mask(shard, now),
+            self._last_access[lo : lo + self.local_capacity],
             self._tick_count,
             max(1, self.local_capacity // 16),
         )
         sm.release_batch(freed)
         if len(victims) == 0:
             return
+        self.metric_unexpired_evictions += len(victims)
         sm.release_batch(victims)
-        self.state = evict_chunked(
-            self._evict, self.state, lo + victims, self.capacity
-        )
+        self._evict_local(shard, victims)
 
+    def _evict_local(self, shard: int, victims: np.ndarray) -> None:
+        """Blocked device evict of one shard's local victim slots."""
+        for start in range(0, len(victims), EVICT_CHUNK):
+            part = victims[start : start + EVICT_CHUNK]
+            w = min(EVICT_CHUNK, pad_pow2(len(part)))
+            blk = np.full((self.n_shards, w), self.local_capacity, np.int64)
+            blk[shard, : len(part)] = part
+            self.state = self.ops.evict(self.state, self.ops.put2(blk))
+
+    # ------------------------------------------------------------------
+    # The tick
+    # ------------------------------------------------------------------
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ) -> List[RateLimitResponse]:
@@ -251,9 +369,7 @@ class MeshTickEngine:
         Packing is column-vectorized like TickEngine.build_batch: one
         Python pass collects request fields, keys resolve in one native
         batch per shard (reclaim + retry on a full shard), and every
-        request-matrix row is one fancy-indexed numpy write — the scalar
-        per-request ``pack_request_col`` loop was the multi-chip host
-        bottleneck."""
+        request-matrix row is one fancy-indexed numpy write."""
         b = self.max_batch
         R = REQ_ROW_INDEX
         self._tick_count += 1
@@ -332,6 +448,12 @@ class MeshTickEngine:
         if len(sel) == 0:
             return spill
 
+        miss_sel = sel[known[sel] == 0]
+        self.metric_hits += len(sel) - len(miss_sel)
+        self.metric_misses += len(miss_sel)
+        if self.store is not None and len(miss_sel):
+            self._read_through(requests, idx, shards, slots, known, miss_sel, now)
+
         m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
         m[:, R["slot"], :] = self.local_capacity
         sh, ps = shards[sel], pos[sel]
@@ -342,14 +464,15 @@ class MeshTickEngine:
                   np.asarray(greg_d, np.int64)[sel]),
         )
 
-        reqs_dev = jax.device_put(
-            m, NamedSharding(self.mesh, P("shard", None, None))
+        self.state, resp = self.ops.tick(
+            self.state, self.ops.put3(m), jnp.int64(now)
         )
-        self.state, resp = self._tick(self.state, reqs_dev, jnp.int64(now))
         self._pending.clear()
         self._pending.update(g_spill_new.tolist())
         rm = np.asarray(resp)  # (n_shards, 5, B)
         self.metric_over_limit += int(rm[sh, 4, ps].sum())
+        if self.store is not None:
+            self._write_through(requests, idx, sel, shards, slots, now)
         status, limit_o, remaining, reset = (
             rm[sh, r, ps].tolist() for r in range(4)
         )
@@ -362,13 +485,109 @@ class MeshTickEngine:
             )
         return spill
 
+    # ------------------------------------------------------------------
+    # Store write/read-through (reference store.go:49-65) — blocked
+    # ------------------------------------------------------------------
+    def _read_through(
+        self, requests, idx, shards, slots, known, miss_sel, now: int
+    ) -> None:
+        """Store.Get for cache misses (algorithms.go:45-51): install the
+        persisted items, blocked per shard, before the tick runs."""
+        rows_by_shard: Dict[int, List[tuple]] = {}
+        restored: set = set()
+        for j in miss_sel:
+            g = int(shards[j]) * self.local_capacity + int(slots[j])
+            if g in restored:
+                known[j] = 1
+                continue
+            item = self.store.get(requests[idx[j]])
+            if item is None:
+                continue
+            restored.add(g)
+            known[j] = 1
+            self._pending.discard(g)
+            rows_by_shard.setdefault(int(shards[j]), []).append(
+                (
+                    (int(slots[j]), item["algorithm"], item["limit"],
+                     item["remaining"], item["duration"], item["created_at"],
+                     item["updated_at"], item["burst"], item["status"],
+                     item["expire_at"], 1),
+                    item.get("remaining_f", 0.0),
+                )
+            )
+        if not rows_by_shard:
+            return
+        w = pad_pow2(max(len(v) for v in rows_by_shard.values()))
+        ints = np.zeros((self.n_shards, len(ITEM_INT_ROWS), w), np.int64)
+        floats = np.zeros((self.n_shards, w), np.float64)
+        for s, rows in rows_by_shard.items():
+            for k, (row, rf) in enumerate(rows):
+                ints[s, :, k] = row
+                floats[s, k] = rf
+        self.state = self.ops.restore(
+            self.state, self.ops.put3(ints), self.ops.put2(floats)
+        )
+
+    def _write_through(
+        self, requests, idx, sel, shards, slots, now: int
+    ) -> None:
+        """Store.OnChange with each touched slot's post-tick state,
+        gathered with one blocked readback (write-through,
+        algorithms.go:149-153); slots cleared by the tick map to
+        Store.remove (remove-on-reset, algorithms.go:78-90)."""
+        # Unique (shard, local slot) per touched bucket, final state only.
+        seen: set = set()
+        per_shard: Dict[int, List[tuple]] = {}
+        for j in sel:
+            g = int(shards[j]) * self.local_capacity + int(slots[j])
+            if g in seen:
+                continue
+            seen.add(g)
+            per_shard.setdefault(int(shards[j]), []).append(
+                (int(slots[j]), requests[idx[j]])
+            )
+        w = pad_pow2(max(len(v) for v in per_shard.values()))
+        blk = np.full((self.n_shards, w), self.local_capacity, np.int64)
+        for s, rows in per_shard.items():
+            blk[s, : len(rows)] = [sl for sl, _ in rows]
+        ints, floats = self.ops.readback(self.state, self.ops.put2(blk))
+        ints = np.asarray(ints)
+        floats = np.asarray(floats)
+        for s, rows in per_shard.items():
+            for k, (sl, req) in enumerate(rows):
+                f = dict(zip(READBACK_ROWS, ints[s, :, k]))
+                key = self.slots[s].key_of(sl)
+                if key is None:
+                    continue
+                if not f["in_use"]:
+                    self.store.remove(key)
+                    continue
+                self.store.on_change(
+                    req,
+                    {
+                        "key": key,
+                        "algorithm": int(f["algorithm"]),
+                        "limit": int(f["limit"]),
+                        "remaining": int(f["remaining"]),
+                        "remaining_f": float(floats[s, k]),
+                        "duration": int(f["duration"]),
+                        "created_at": int(f["created_at"]),
+                        "updated_at": int(f["updated_at"]),
+                        "burst": int(f["burst"]),
+                        "status": int(f["status"]),
+                        "expire_at": int(f["expire_at"]),
+                    },
+                )
+
+    # ------------------------------------------------------------------
+    # GLOBAL installs (UpdatePeerGlobals receive path) — blocked
+    # ------------------------------------------------------------------
     def install_globals(
         self, updates: Sequence[GlobalUpdate], now: Optional[int] = None
     ) -> None:
-        """Install owner-pushed GLOBAL state (UpdatePeerGlobals receive path);
-        see TickEngine.install_globals.  Slot scatter crosses shards — XLA
-        routes each row to its owning device; this path is off the hot loop
-        (100ms broadcast cadence)."""
+        """Install owner-pushed GLOBAL state; see TickEngine.install_globals.
+        One blocked install per RESTORE_CHUNK of the widest shard — each
+        device writes only its own shard's rows."""
         if not updates:
             return
         with self._lock:
@@ -376,30 +595,63 @@ class MeshTickEngine:
             # New logical tick so the "touched this tick" reclaim guard
             # doesn't pin the previous tick's slots (see TickEngine).
             self._tick_count += 1
-            cols = []
+            by_slot: Dict[int, tuple] = {}
             for u in updates:
                 shard = self._shard_of(u.key)
                 g, _ = self._resolve(u.key, shard, now)
                 if g is None:
-                    continue  # shard full; drop this update (next broadcast retries)
+                    continue  # shard full; drop (the next broadcast retries)
                 self._pending.discard(g)
-                cols.append(
-                    (g, u.algorithm, u.status.limit, u.status.remaining,
-                     u.status.status, u.duration, u.status.reset_time, 1)
+                # Dedup by slot, LAST update wins (install order) — one
+                # scatter row per slot (see TickEngine.install_globals).
+                by_slot[g] = (
+                    g % self.local_capacity, u.algorithm, u.status.limit,
+                    u.status.remaining, u.status.status, u.duration,
+                    u.status.reset_time, 1,
                 )
-            if cols:
-                m = np.zeros((8, pad_pow2(len(cols))), np.int64)
-                m[:, : len(cols)] = np.array(cols, np.int64).T
-                self.state = self._install(self.state, jnp.asarray(m), jnp.int64(now))
+            if not by_slot:
+                return
+            per_shard: Dict[int, List[tuple]] = {}
+            for g, row in by_slot.items():
+                per_shard.setdefault(g // self.local_capacity, []).append(row)
+            widest = max(len(v) for v in per_shard.values())
+            for start in range(0, widest, RESTORE_CHUNK):
+                w = pad_pow2(
+                    min(RESTORE_CHUNK,
+                        max(len(v) - start for v in per_shard.values()))
+                )
+                if w <= 0:
+                    break
+                blk = np.zeros((self.n_shards, 8, w), np.int64)
+                for s, rows in per_shard.items():
+                    part = rows[start : start + w]
+                    if part:
+                        blk[s, :, : len(part)] = np.array(part, np.int64).T
+                self.state = self.ops.install(
+                    self.state, self.ops.put3(blk), jnp.int64(now)
+                )
 
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog; see TickEngine)
     # ------------------------------------------------------------------
+    def _host_state(self):
+        """Host-side stored-layout columns of the whole sharded table."""
+        if self.layout == "row":
+            table = np.asarray(self.state.table)
+            cap1 = self.local_capacity + 1
+            # Drop each shard's guard row, re-concatenate the data rows.
+            data = table.reshape(self.n_shards, cap1, ROW_W)[:, :-1, :]
+            flat = np.ascontiguousarray(
+                data.reshape(self.capacity, ROW_W)
+            )
+            return rowtable.host_columns_from_rows(flat)
+        return jax.tree.map(np.asarray, self.state)
+
     def export_items(self) -> List[dict]:
         """Drain live bucket state to host dicts — one D2H gather of the
         sharded table + one native key export per shard."""
         with self._lock:
-            st = jax.tree.map(np.asarray, self.state)
+            st = self._host_state()
             mapped = np.concatenate([sm.mapped_mask() for sm in self.slots])
             live = np.flatnonzero(mapped & st.in_use)
             if len(live) == 0:
@@ -414,18 +666,23 @@ class MeshTickEngine:
 
     def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
         """Install snapshot items into the sharded table: route each key to
-        its shard, batch-assign per shard, one jitted scatter for the data
-        (XLA places each row on its owning device)."""
+        its shard, batch-assign per shard, blocked restore scatters."""
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
             self._tick_count += 1  # unblock LRU reclaim (see install_globals)
-            live = [it for it in items if it["expire_at"] >= now]
+            # Dedup by key (last wins): duplicate keys resolve to one slot
+            # and two restore rows aimed at the same slot are a data race
+            # in the row layout's DMA scatter (see TickEngine.load_columns).
+            live_by_key = {
+                it["key"]: it for it in items if it["expire_at"] >= now
+            }
+            live = list(live_by_key.values())
             if not live:
                 return
             by_shard: List[List[int]] = [[] for _ in range(self.n_shards)]
             for j, it in enumerate(live):
                 by_shard[self._shard_of(it["key"])].append(j)
-            gslots = np.full(len(live), -1, np.int64)
+            lslots = np.full(len(live), -1, np.int64)
             for d, idxs in enumerate(by_shard):
                 if not idxs:
                     continue
@@ -446,15 +703,45 @@ class MeshTickEngine:
                     ls[retry] = self.slots[d].assign_batch(
                         [live[idxs[r]]["key"].encode() for r in retry]
                     )
-                gslots[idxs] = np.where(ls >= 0, lo + ls, -1)
-            ok = np.flatnonzero(gslots >= 0)  # full shards: drop those rows
-            if len(ok) == 0:
+                lslots[idxs] = ls
+            # Blocked restore: chunk by the widest shard.
+            per_shard = [
+                [j for j in idxs if lslots[j] >= 0]
+                for idxs in by_shard
+            ]
+            widest = max((len(v) for v in per_shard), default=0)
+            if widest == 0:
                 return
-            ints, floats = pack_restore_matrix(live, ok, gslots)
-            self._last_access[gslots[ok]] = self._tick_count
-            self.state = self._restore(
-                self.state, jnp.asarray(ints), jnp.asarray(floats)
-            )
+            for d, idxs in enumerate(per_shard):
+                if idxs:
+                    g = d * self.local_capacity + lslots[idxs]
+                    self._last_access[g] = self._tick_count
+            for start in range(0, widest, RESTORE_CHUNK):
+                w = pad_pow2(
+                    min(RESTORE_CHUNK,
+                        max((len(v) - start for v in per_shard), default=0))
+                )
+                if w <= 0:
+                    break
+                ints = np.zeros((self.n_shards, len(ITEM_INT_ROWS), w), np.int64)
+                floats = np.zeros((self.n_shards, w), np.float64)
+                any_rows = False
+                for s, idxs in enumerate(per_shard):
+                    part = idxs[start : start + w]
+                    if not part:
+                        continue
+                    any_rows = True
+                    k = len(part)
+                    ints[s, 0, :k] = lslots[part]
+                    for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
+                        ints[s, r, :k] = [live[j][name] for j in part]
+                    ints[s, -1, :k] = 1
+                    floats[s, :k] = [live[j]["remaining_f"] for j in part]
+                if not any_rows:
+                    break
+                self.state = self.ops.restore(
+                    self.state, self.ops.put3(ints), self.ops.put2(floats)
+                )
 
     def cache_size(self) -> int:
         return sum(len(sm) for sm in self.slots)

@@ -114,20 +114,14 @@ def _make_engine(conf: InstanceConfig):
     if conf.tpu_mesh_shards > 1:
         from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
 
-        if conf.store is not None:
-            # Write/read-through needs a per-tick readback path the sharded
-            # engine doesn't have yet; fail loudly rather than silently
-            # disabling persistence.
-            raise ValueError(
-                "Store write/read-through is not supported with "
-                "GUBER_TPU_MESH_SHARDS > 1; use a Loader snapshot instead"
-            )
         devices = jax.devices()[: conf.tpu_mesh_shards]
         local_cap = max(1, conf.cache_size // len(devices))
         return MeshTickEngine(
             mesh=make_mesh(devices),
             local_capacity=local_cap,
             max_batch=conf.tpu_max_batch,
+            store=conf.store,
+            table_layout=conf.tpu_table_layout,
         )
     from gubernator_tpu.ops.engine import TickEngine
 

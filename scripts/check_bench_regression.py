@@ -16,6 +16,7 @@ between rounds).
 
 import argparse
 import json
+import re
 import sys
 
 RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
@@ -29,17 +30,45 @@ def load_bench(path):
     if "value" in doc:
         return doc
     for line in reversed(doc.get("tail", "").splitlines()):
-        if line.startswith("{") and '"metric"' in line:
-            return json.loads(line)
+        # The headline may not be the last line (r02's abort traceback
+        # followed it) and may be truncated by the tail capture — salvage
+        # whatever parses.
+        i = line.find('{"metric"')
+        if i < 0:
+            continue
+        try:
+            return json.loads(line[i:])
+        except json.JSONDecodeError:
+            continue
+    # Truncated tail (r02's was cut mid-ladder): salvage every complete
+    # {"rung": ...} object so partial rounds still gate their rungs.
+    rungs = []
+    for m in re.finditer(r'\{"rung":.*?\}', doc.get("tail", "")):
+        try:
+            rungs.append(json.loads(m.group(0)))
+        except json.JSONDecodeError:
+            continue
+    if rungs:
+        return {"value": None, "ladder": rungs, "salvaged": True}
     raise SystemExit(f"{path}: no bench result found")
 
 
 def rates(doc):
-    out = {"headline": float(doc["value"])}
+    """rung → (rate, shape_key).  The shape key carries the workload
+    parameters (key count, batch width) so a BENCH_FAST candidate is
+    never gated against a full-size baseline under the same rung name —
+    mismatched shapes are reported, not judged (the reference gate
+    compares like-for-like PR-vs-master runs on one runner)."""
+    out = {}
+    if doc.get("value") is not None:
+        out["headline"] = (float(doc["value"]), ())
     for rung in doc.get("ladder", []):
+        shape = tuple(
+            (k, rung[k]) for k in ("keys", "batch", "nodes") if k in rung
+        )
         for k in RATE_KEYS:
             if rung.get(k):
-                out[rung["rung"]] = float(rung[k])
+                out[rung["rung"]] = (float(rung[k]), shape)
                 break
     return out
 
@@ -57,10 +86,15 @@ def main():
 
     failed = False
     for name in sorted(set(base) | set(cand)):
-        b, c = base.get(name), cand.get(name)
-        if b is None or c is None:
+        bs, cs = base.get(name), cand.get(name)
+        if bs is None or cs is None:
             print(f"  {name}: only in "
-                  f"{'candidate' if b is None else 'baseline'} — not gated")
+                  f"{'candidate' if bs is None else 'baseline'} — not gated")
+            continue
+        (b, b_shape), (c, c_shape) = bs, cs
+        if b_shape != c_shape:
+            print(f"  {name}: workload shape differs "
+                  f"({dict(b_shape)} vs {dict(c_shape)}) — not gated")
             continue
         if c <= 0:
             print(f"  {name}: candidate rate is 0 — FAIL")

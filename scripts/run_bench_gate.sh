@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Bench regression gate: run the ladder (BENCH_FAST) and compare against
+# the most recent recorded round (BENCH_r*.json), failing on >200%
+# regression — the reference's CI discipline
+# (/root/reference/.github/workflows/on-pull-request.yml go-bench job).
+#
+# Usage: scripts/run_bench_gate.sh [baseline.json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+baseline="${1:-}"
+if [ -z "$baseline" ]; then
+    baseline=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1 || true)
+fi
+if [ -z "$baseline" ]; then
+    echo "no baseline BENCH_r*.json found; nothing to gate against"
+    exit 0
+fi
+
+out=$(mktemp)
+BENCH_FAST=1 python bench.py | tail -1 > "$out"
+echo "candidate: $(cat "$out" | head -c 300)..."
+python scripts/check_bench_regression.py "$baseline" "$out"

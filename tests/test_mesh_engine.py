@@ -127,3 +127,81 @@ def test_matches_single_device_engine():
                 y.remaining,
                 y.reset_time,
             )
+
+
+def test_mesh_row_layout_matches_columns():
+    """The Pallas row layout on the sharded mesh (interpret mode on CPU)
+    must agree with the column layout decision for decision."""
+    row = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16, table_layout="row"
+    )
+    col = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16,
+        table_layout="columns",
+    )
+    assert row.layout == "row" and col.layout == "columns"
+    for t in range(3):
+        reqs = [req(f"rl{i}", hits=1, limit=7) for i in range(24)]
+        a = row.process(reqs, now=NOW + t)
+        b = col.process(reqs, now=NOW + t)
+        assert [(r.status, r.remaining, r.reset_time) for r in a] == \
+               [(r.status, r.remaining, r.reset_time) for r in b]
+
+
+def test_mesh_row_layout_snapshot_roundtrip():
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16, table_layout="row"
+    )
+    eng.process([req(f"snapr{i}", hits=2, limit=9) for i in range(20)], now=NOW)
+    items = eng.export_items()
+    assert len(items) == 20
+    e2 = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16, table_layout="row"
+    )
+    e2.load_items(items, now=NOW + 1)
+    out = e2.process([req("snapr3", hits=0, limit=9)], now=NOW + 1)[0]
+    assert out.remaining == 7
+
+
+def test_mesh_store_write_and_read_through():
+    """Store on the sharded engine: on_change after every mutation,
+    get() consulted on miss, remove() on eviction-by-reset."""
+    from gubernator_tpu.store import MockStore
+
+    store = MockStore()
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16, store=store
+    )
+    eng.process([req("st1", hits=2, limit=10)], now=NOW)
+    assert store.called["OnChange()"] == 1
+    item = store.data["mesh_st1"]
+    assert item["remaining"] == 8
+
+    # A fresh engine read-throughs the persisted state on miss.
+    eng2 = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=16, store=store
+    )
+    out = eng2.process([req("st1", hits=1, limit=10)], now=NOW + 1)[0]
+    assert out.remaining == 7
+    assert store.called["Get()"] >= 1
+
+
+def test_mesh_store_via_instance_config():
+    """The service layer no longer refuses Store + mesh shards."""
+    import asyncio
+
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+    from gubernator_tpu.store import MockStore
+
+    async def run():
+        conf = InstanceConfig(
+            cache_size=256, tpu_mesh_shards=2, store=MockStore(),
+            tpu_max_batch=16,
+        )
+        inst = await V1Instance.create(conf)
+        out = await inst.get_rate_limits([req("svc1", hits=1, limit=5)])
+        assert out[0].remaining == 4
+        assert conf.store.called["OnChange()"] >= 1
+        await inst.close()
+
+    asyncio.run(run())

@@ -113,15 +113,19 @@ async def test_loader_with_mesh_engine():
     assert loader.contents[0]["remaining"] == 3
 
 
-def test_store_with_mesh_shards_rejected():
-    """Store write/read-through has no sharded path yet: combining it with
-    tpu_mesh_shards > 1 must fail loudly, not silently drop persistence."""
+def test_store_with_mesh_shards_supported():
+    """Store write/read-through works on the sharded engine (per-shard
+    blocked readback/restore; the round-2 guard that refused this combo
+    is gone)."""
     from gubernator_tpu.service.instance import InstanceConfig, _make_engine
     from gubernator_tpu.store import MockStore
 
-    conf = InstanceConfig(store=MockStore(), tpu_mesh_shards=2, cache_size=256)
-    with pytest.raises(ValueError, match="Store"):
-        _make_engine(conf)
+    store = MockStore()
+    conf = InstanceConfig(store=store, tpu_mesh_shards=2, cache_size=256)
+    eng = _make_engine(conf)
+    eng.process([req(hits=3)], now=NOW)
+    assert store.called["OnChange()"] == 1
+    assert store.data["store_test_k"]["remaining"] == 2
 
 
 def test_loader_drops_expired_items():
