@@ -328,6 +328,165 @@ def rung_snapshot(engine, label):
 
 
 # ----------------------------------------------------------------------
+# Rung: 100M keys (the top of the BASELINE.md config ladder)
+# ----------------------------------------------------------------------
+def rung_100m():
+    """100M keys, columns layout, DRAIN_OVER_LIMIT on all traffic,
+    RESET_REMAINING on 1/64, multi-region picker on the lookup path.
+
+    Memory budget: the column table stores 20 int32 words/slot = 80 B/slot
+    → **8.0 GB HBM at 100M** (v5e has 16 GB; the row layout would need
+    512 B/slot = 51 GB, which is why make_layout_choice caps it at 6 GB
+    and auto falls back to columns here).  Host side: C++ slotmap ≈8 GB
+    (hash buckets + SSO key strings) + 0.8 GB last-access.
+
+    The table is populated DEVICE-SIDE — one donated jitted init writes
+    synthetic bucket state straight into HBM — while the native slotmap
+    assigns the same 100M keys host-side, so host and device agree on
+    key→slot.  Pushing 100M real inserts through the harness link
+    (~1-8 MB/s measured, see probe_bandwidth) would take ~30+ minutes
+    and measure the tunnel, not the engine.
+    """
+    from functools import partial
+
+    from gubernator_tpu.ops.buckets import BucketState, to_stored
+    from gubernator_tpu.ops.engine import TickEngine, resolve_ticks
+    from gubernator_tpu.parallel.hashring import HASH_FUNCTIONS, RegionPicker
+    from gubernator_tpu.types import Behavior, PeerInfo
+
+    cap = 100_000_000
+    now = 1_700_000_000_000
+    limit = 1_000_000
+    duration = 3_600_000
+    batch = 4096
+    eng = TickEngine(capacity=cap, max_batch=batch, table_layout="columns")
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def synth(state, t):
+        idx = jnp.arange(cap, dtype=jnp.int64)
+        algo = (idx & 1).astype(jnp.int32)
+        leaky = algo == 1
+
+        def f64(v):
+            return jnp.full(cap, v, jnp.int64)
+
+        return BucketState(
+            algorithm=algo,
+            limit=to_stored(f64(limit), "limit"),
+            remaining=to_stored(
+                jnp.where(leaky, jnp.int64(0), jnp.int64(limit)), "remaining"
+            ),
+            remaining_f=to_stored(
+                jnp.where(leaky, float(limit), 0.0), "remaining_f"
+            ),
+            duration=to_stored(f64(duration), "duration"),
+            created_at=to_stored(f64(now), "created_at"),
+            updated_at=to_stored(
+                jnp.where(leaky, t, jnp.int64(0)), "updated_at"
+            ),
+            burst=to_stored(
+                jnp.where(leaky, jnp.int64(limit), jnp.int64(0)), "burst"
+            ),
+            status=jnp.zeros(cap, jnp.int32),
+            expire_at=to_stored(f64(now + duration), "expire_at"),
+            in_use=jnp.ones(cap, jnp.bool_),
+        )
+
+    t0 = time.perf_counter()
+    eng.state = synth(eng.state, jnp.int64(now))
+    jax.block_until_ready(jax.tree.leaves(eng.state)[0])
+    dev_fill_s = time.perf_counter() - t0
+
+    # Host slotmap: assign the same keys, chunked to bound transients.
+    # The C++ free list hands out slots 0,1,2,... in insertion order, so
+    # key bench_<i> lands in slot i — matching the synthetic device fill.
+    t0 = time.perf_counter()
+    step = 10_000_000
+    for start in range(0, cap, step):
+        ids = np.arange(start, min(start + step, cap))
+        blob, offsets = _key_pack(ids)
+        slots = eng.slots.assign_blob(blob, offsets)
+        assert slots[0] == start and slots[-1] == ids[-1], "slot order broke"
+    key_fill_s = time.perf_counter() - t0
+
+    # Multi-region picker: 3 DCs x 3 peers, the MULTI_REGION lookup hook
+    # (region_picker.go:57-69) exercised per measured batch.
+    picker: RegionPicker = RegionPicker(HASH_FUNCTIONS["fnv1"], 512)
+    for dc in ("us-east-1", "us-west-2", "eu-west-1"):
+        for p in range(3):
+            picker.add(PeerInfo(grpc_address=f"{dc}-{p}:81", datacenter=dc))
+    pickers = list(picker.pickers().values())
+
+    DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
+    RESET = int(Behavior.RESET_REMAINING)
+    # Warm tick: the FIRST fresh key against the exactly-full table pays
+    # the one-time synchronous reclaim (capacity//16 ≈ 6M frees at 100M);
+    # after it the background reclaimer keeps headroom off the hot path.
+    eng.process_columns(
+        _cols(np.arange(cap, cap + batch), limit, duration, None), now=now + 1
+    )
+    rng = np.random.default_rng(7)
+    batches = []
+    fresh_next = cap + batch
+    for _ in range(16):
+        ids = np.minimum(rng.zipf(1.2, batch) * 1000 - 1, cap - 1)
+        ids[: batch // 100] = np.arange(
+            fresh_next, fresh_next + batch // 100
+        )  # 1% fresh keys: keeps background reclaim live at capacity
+        fresh_next += batch // 100
+        c = _cols(ids, limit, duration, None)
+        c.behavior[:] = DRAIN
+        # RESET_REMAINING rides the fresh (unique-per-batch) rows: resets
+        # target specific keys in practice, and a RESET row inside a
+        # zipf-hot duplicate group would break that group's closed-form
+        # herd merge and degenerate the tick into per-duplicate rank
+        # rounds (measured 6.5 s/tick at 100M) — a worst case no real
+        # reset traffic exhibits.
+        c.behavior[: batch // 100] |= RESET
+        keys = ["bench_" + str(i) for i in ids]
+        batches.append((c, keys))
+
+    ticks = 10 if FAST else 50
+    done = 0
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(ticks):
+        c, keys = batches[i % len(batches)]
+        for ring in pickers:  # every region resolves its owner
+            ring.get_batch(keys)
+        pending.append(eng.submit_columns(c, now + 1 + i))
+        done += len(c)
+        if len(pending) >= 16:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
+    dt = time.perf_counter() - t0
+
+    lat = []
+    for i in range(min(ticks, 30)):
+        c, keys = batches[i % len(batches)]
+        t1 = time.perf_counter()
+        eng.process_columns(c, now=now + 1000 + i)
+        lat.append((time.perf_counter() - t1) * 1e3)
+    p50, p99 = _pcts(lat)
+    out = {
+        "rung": "engine_100m_drain_reset_region",
+        "keys": cap,
+        "dev_fill_s": round(dev_fill_s, 1),
+        "key_fill_s": round(key_fill_s, 1),
+        "decisions_per_sec": round(done / dt, 1),
+        "batch": batch,
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "evictions": eng.metric_unexpired_evictions,
+        "hbm_table_gb": round(cap * 80 / 2**30, 2),
+        "regions": len(pickers),
+    }
+    eng.close()
+    return out
+
+
+# ----------------------------------------------------------------------
 # Service-level rung: loopback gRPC through a real daemon
 # ----------------------------------------------------------------------
 async def _service_bench(n_batches, batch, concurrency):
@@ -407,6 +566,56 @@ def rung_service():
 
 
 # ----------------------------------------------------------------------
+# Sharded-table mesh rung (8 virtual devices, CPU backend, subprocess)
+# ----------------------------------------------------------------------
+def child_mesh_tick():
+    """Runs in the subprocess: MeshTickEngine over an 8-device mesh —
+    the multi-chip WorkerPool analog (one table sharded over the mesh,
+    per-shard request blocks, no collectives on the hot path)."""
+    jax.config.update("jax_platforms", "cpu")
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+    from gubernator_tpu.types import RateLimitRequest
+
+    n_nodes = 8
+    batch = 512
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=1 << 13, max_batch=batch
+    )
+    rng = np.random.default_rng(5)
+
+    def window():
+        return [
+            RateLimitRequest(
+                name="m", unique_key=str(k), hits=1, limit=1_000_000,
+                duration=3_600_000,
+            )
+            for k in rng.integers(0, 1 << 15, n_nodes * batch)
+        ]
+
+    eng.process(window(), now=1_700_000_000_000)  # warm/compile
+    windows = [window() for _ in range(4)]
+    iters = 5 if FAST else 20
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(iters):
+        w = windows[i % len(windows)]
+        eng.process(w, now=1_700_000_000_000 + i)
+        done += len(w)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "rung": "mesh_tick_8",
+                "shards": n_nodes,
+                "decisions_per_sec": round(done / dt, 1),
+                "layout": eng.layout,
+                "backend": "cpu-8dev",
+            }
+        )
+    )
+
+
+# ----------------------------------------------------------------------
 # GLOBAL mesh rung (8 virtual devices, CPU backend, subprocess)
 # ----------------------------------------------------------------------
 def child_mesh():
@@ -464,7 +673,8 @@ def child_mesh():
     )
 
 
-def rung_global_mesh():
+def _run_child(flag: str, rung: str):
+    """Run one bench child on the 8-virtual-device CPU backend."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
@@ -476,7 +686,7 @@ def rung_global_mesh():
     )
     try:
         out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child-mesh"],
+            [sys.executable, os.path.abspath(__file__), flag],
             env=env,
             capture_output=True,
             text=True,
@@ -486,10 +696,18 @@ def rung_global_mesh():
         lines = out.stdout.strip().splitlines()
         if not lines:
             tail = out.stderr.strip().splitlines()[-8:]
-            return {"rung": "global_mesh_8", "error": " | ".join(tail)[:500]}
+            return {"rung": rung, "error": " | ".join(tail)[:500]}
         return json.loads(lines[-1])
     except Exception as e:
-        return {"rung": "global_mesh_8", "error": str(e)[:200]}
+        return {"rung": rung, "error": str(e)[:200]}
+
+
+def rung_global_mesh():
+    return _run_child("--child-mesh", "global_mesh_8")
+
+
+def rung_mesh_tick():
+    return _run_child("--child-mesh-tick", "mesh_tick_8")
 
 
 # ----------------------------------------------------------------------
@@ -591,10 +809,18 @@ def main():
         ladder.append(_safe(
             "snapshot_10m", lambda: rung_snapshot(big_engine, "snapshot_10m")
         ))
+        if hasattr(big_engine, "close"):
+            big_engine.close()
         del big_engine
     state.clear()
 
+    if not FAST:
+        # Top of the ladder: needs 8 GB HBM free — runs after the 10M
+        # engines are released, before the (small) service daemon.
+        ladder.append(_safe("engine_100m_drain_reset_region", rung_100m))
+
     ladder.append(_safe("service_grpc", rung_service))
+    ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
 
     print(
@@ -624,7 +850,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--child-mesh" in sys.argv:
+    if "--child-mesh-tick" in sys.argv:
+        child_mesh_tick()
+    elif "--child-mesh" in sys.argv:
         child_mesh()
     else:
         main()

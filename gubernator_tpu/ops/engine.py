@@ -1246,6 +1246,19 @@ class SubmittedBatch:
     def handles(self) -> List[TickHandle]:
         return self._handles
 
+    def matrix(self) -> tuple[np.ndarray, Dict[int, str]]:
+        """(5, n) response matrix in request order + per-item errors
+        (the columnar result shape; responses() wraps it in dataclasses)."""
+        resolve_ticks(self._handles)  # one D2H for all chunks
+        out = np.empty((5, self._n), np.int64)
+        errors: Dict[int, str] = {}
+        for h, (s, e) in zip(self._handles, self._spans):
+            rm, errs = h.result()
+            out[:, s:e] = rm
+            for i, msg in errs.items():
+                errors[s + i] = msg
+        return out, errors
+
     def responses(self) -> List[RateLimitResponse]:
         resolve_ticks(self._handles)  # one D2H for all chunks
         out: List[Optional[RateLimitResponse]] = [None] * self._n
@@ -1710,46 +1723,12 @@ class TickEngine:
                 handle.result()
             return handle
 
-    def process_columns(
+    def submit_cols(
         self, cols: ReqColumns, now: Optional[int] = None
-    ) -> tuple[np.ndarray, Dict[int, str]]:
-        """Apply a columnar batch; returns the (5, n) response matrix in
-        request order (rows: status, limit, remaining, reset_time,
-        over_limit) plus per-item errors.  Batches wider than ``max_batch``
-        run as a pipeline of chunked ticks: chunk k+1 is packed and
-        dispatched while chunk k executes on device."""
-        n = len(cols)
-        if n == 0:
-            return np.zeros((5, 0), np.int64), {}
-        now = now if now is not None else timeutil.now_ms()
-        if n <= self.max_batch:
-            return self.submit_columns(cols, now).result()
-        spans = [
-            (s, min(s + self.max_batch, n))
-            for s in range(0, n, self.max_batch)
-        ]
-        handles = [
-            self.submit_columns(cols.slice_chunk(s, e), now) for s, e in spans
-        ]
-        resolve_ticks(handles)  # one D2H for the whole chunk pipeline
-        out = np.empty((5, n), np.int64)
-        errors: Dict[int, str] = {}
-        for h, (s, e) in zip(handles, spans):
-            rm, errs = h.result()
-            out[:, s:e] = rm
-            for i, msg in errs.items():
-                errors[s + i] = msg
-        return out, errors
-
-    def submit(
-        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ) -> SubmittedBatch:
-        """Dispatch an object-level batch without awaiting the device: the
-        tick loop's pipelining hook (resolve via ``.responses()`` on a
-        reader thread while this thread packs the next window)."""
-        cols = ReqColumns.from_requests(
-            requests, keep_refs=self.store is not None
-        )
+        """Dispatch a columnar batch of any width without awaiting the
+        device (chunked into max_batch ticks; chunk k+1 packs while chunk
+        k executes).  Resolve via ``.matrix()`` / ``.responses()``."""
         n = len(cols)
         now = now if now is not None else timeutil.now_ms()
         spans = [
@@ -1763,6 +1742,29 @@ class TickEngine:
             for s, e in spans
         ]
         return SubmittedBatch(handles, spans, n)
+
+    def process_columns(
+        self, cols: ReqColumns, now: Optional[int] = None
+    ) -> tuple[np.ndarray, Dict[int, str]]:
+        """Apply a columnar batch; returns the (5, n) response matrix in
+        request order (rows: status, limit, remaining, reset_time,
+        over_limit) plus per-item errors."""
+        if len(cols) == 0:
+            return np.zeros((5, 0), np.int64), {}
+        return self.submit_cols(cols, now).matrix()
+
+    def submit(
+        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
+    ) -> SubmittedBatch:
+        """Dispatch an object-level batch without awaiting the device: the
+        tick loop's pipelining hook (resolve via ``.responses()`` on a
+        reader thread while this thread packs the next window)."""
+        return self.submit_cols(
+            ReqColumns.from_requests(
+                requests, keep_refs=self.store is not None
+            ),
+            now,
+        )
 
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None

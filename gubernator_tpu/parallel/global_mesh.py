@@ -46,6 +46,23 @@ table; the winner across nodes is picked with a ``pmax`` over write stamps.
 
 gRPC remains the reconciliation transport only *across* meshes (separate
 clusters / DCs) — within a mesh no RPC is issued at all.
+
+**Scaling envelope (read before raising ``capacity``).**  A reconcile is
+*dense*: it all-gathers the (3, capacity) accumulators plus the per-node
+authoritative slices and applies ``bucket_transition`` to every slot —
+O(capacity · n_nodes) device work and O(capacity · n_nodes · 8 B) ICI
+traffic per step, independent of how many slots were actually hit.  Each
+node also holds a full replica (~100 B/slot).  That trade is deliberate:
+at the GLOBAL keyspace the reference sustains (its defaults cap the whole
+cache at 50K items, config.go:139) a dense 64K-slot reconcile is ~25 MB
+of collective traffic every 100 ms — microseconds of a v5e ICI's
+~10 GB/s/link — and the dense form needs no gather/scatter or
+host-driven sparsity bookkeeping.  It does NOT extend to tables near the
+serving table's 10M–100M scale: at 10M slots a step would move ~4 GB over
+ICI and rewrite the full replica per node.  GLOBAL limits are a small,
+hot subset of the keyspace (the reference's design assumption too);
+keep ``capacity`` in the 2^14–2^20 range, and shard the *serving* table
+(mesh_engine.py) — not this one — for bulk keyspace scale.
 """
 
 from __future__ import annotations

@@ -320,7 +320,13 @@ class MeshTickEngine:
         self._evict_local(shard, victims)
 
     def _evict_local(self, shard: int, victims: np.ndarray) -> None:
-        """Blocked device evict of one shard's local victim slots."""
+        """Blocked device evict of one shard's local victim slots.
+
+        One whole-mesh dispatch per reclaiming shard (other shards' rows
+        pad to the guard).  Reclaims are per-shard events driven from the
+        resolve loop, so the common case is exactly one shard per tick;
+        if profiling ever shows multi-shard reclaim storms, batch the
+        victim blocks across shards the way install_globals does."""
         for start in range(0, len(victims), EVICT_CHUNK):
             part = victims[start : start + EVICT_CHUNK]
             w = min(EVICT_CHUNK, pad_pow2(len(part)))
@@ -448,7 +454,18 @@ class MeshTickEngine:
         if len(sel) == 0:
             return spill
 
-        miss_sel = sel[known[sel] == 0]
+        miss_like = known[sel] == 0
+        if self.store is not None and self._pending:
+            # A block-overflow spill's fresh slot re-resolves as known=1 on
+            # its retry tick, but the device never wrote it — it is still
+            # in _pending.  Those rows must read-through too, or persisted
+            # state is silently dropped for exactly the spilled keys.
+            g_sel = shards[sel] * self.local_capacity + slots[sel]
+            pend = self._pending
+            miss_like = miss_like | np.fromiter(
+                (int(g) in pend for g in g_sel), np.bool_, len(g_sel)
+            )
+        miss_sel = sel[miss_like]
         self.metric_hits += len(sel) - len(miss_sel)
         self.metric_misses += len(miss_sel)
         if self.store is not None and len(miss_sel):
@@ -484,6 +501,29 @@ class MeshTickEngine:
                 reset_time=reset[t],
             )
         return spill
+
+    @staticmethod
+    def _blocked_chunks(per_shard):
+        """Chunk schedule for blocked per-shard matrices: yields (start, w)
+        strided by RESTORE_CHUNK with w = pad_pow2 of the widest shard's
+        remaining rows (capped at RESTORE_CHUNK).  The stride/width
+        interplay is subtle — when the remainder fits, w covers ALL of
+        every shard's remaining rows, so stepping a full RESTORE_CHUNK
+        skips nothing — and lives only here."""
+        lens = [len(v) for v in (
+            per_shard.values() if isinstance(per_shard, dict) else per_shard
+        )]
+        widest = max(lens, default=0)
+        start = 0
+        while start < widest:
+            w = pad_pow2(min(
+                RESTORE_CHUNK,
+                max((n - start for n in lens if n > start), default=0),
+            ))
+            if w <= 0:
+                return
+            yield start, w
+            start += RESTORE_CHUNK
 
     # ------------------------------------------------------------------
     # Store write/read-through (reference store.go:49-65) — blocked
@@ -614,14 +654,7 @@ class MeshTickEngine:
             per_shard: Dict[int, List[tuple]] = {}
             for g, row in by_slot.items():
                 per_shard.setdefault(g // self.local_capacity, []).append(row)
-            widest = max(len(v) for v in per_shard.values())
-            for start in range(0, widest, RESTORE_CHUNK):
-                w = pad_pow2(
-                    min(RESTORE_CHUNK,
-                        max(len(v) - start for v in per_shard.values()))
-                )
-                if w <= 0:
-                    break
+            for start, w in self._blocked_chunks(per_shard):
                 blk = np.zeros((self.n_shards, 8, w), np.int64)
                 for s, rows in per_shard.items():
                     part = rows[start : start + w]
@@ -709,36 +742,23 @@ class MeshTickEngine:
                 [j for j in idxs if lslots[j] >= 0]
                 for idxs in by_shard
             ]
-            widest = max((len(v) for v in per_shard), default=0)
-            if widest == 0:
-                return
             for d, idxs in enumerate(per_shard):
                 if idxs:
                     g = d * self.local_capacity + lslots[idxs]
                     self._last_access[g] = self._tick_count
-            for start in range(0, widest, RESTORE_CHUNK):
-                w = pad_pow2(
-                    min(RESTORE_CHUNK,
-                        max((len(v) - start for v in per_shard), default=0))
-                )
-                if w <= 0:
-                    break
+            for start, w in self._blocked_chunks(per_shard):
                 ints = np.zeros((self.n_shards, len(ITEM_INT_ROWS), w), np.int64)
                 floats = np.zeros((self.n_shards, w), np.float64)
-                any_rows = False
                 for s, idxs in enumerate(per_shard):
                     part = idxs[start : start + w]
                     if not part:
                         continue
-                    any_rows = True
                     k = len(part)
                     ints[s, 0, :k] = lslots[part]
                     for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
                         ints[s, r, :k] = [live[j][name] for j in part]
                     ints[s, -1, :k] = 1
                     floats[s, :k] = [live[j]["remaining_f"] for j in part]
-                if not any_rows:
-                    break
                 self.state = self.ops.restore(
                     self.state, self.ops.put3(ints), self.ops.put2(floats)
                 )

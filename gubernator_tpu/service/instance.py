@@ -328,6 +328,52 @@ class V1Instance:
             out[i] = await t
         return out  # type: ignore[return-value]
 
+    def columns_fast_path_ok(self) -> bool:
+        """Whether GetRateLimits may run wire→columns→device with no
+        per-request objects: requires every key to be local (standalone,
+        no peers), no server-forced GLOBAL, no Store (read-through takes
+        request objects), and an engine speaking columns.  The transport
+        additionally falls back per batch when an item carries GLOBAL
+        behavior, metadata (trace context), or a validation error."""
+        return (
+            len(self.local_picker) == 0
+            and self.global_mesh is None
+            and not self.conf.behaviors.force_global
+            and self.conf.store is None
+            and hasattr(self.engine, "submit_cols")
+        )
+
+    async def get_rate_limits_columns(self, cols):
+        """Columnar GetRateLimits (the fast path; see
+        columns_fast_path_ok).  Returns ``((5, n) matrix, errors)`` in
+        request order; the transport writes wire responses straight from
+        the matrix."""
+        if len(cols) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels(error="Request too large").inc()
+            raise BatchTooLargeError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        self.metrics.concurrent_checks.inc()
+        t0 = time.perf_counter()
+        try:
+            mat, errors = await asyncio.wrap_future(
+                self.tick_loop.submit_columns(cols)
+            )
+            self.metrics.getratelimit_counter.labels(calltype="local").inc(
+                len(cols) - len(errors)
+            )
+            over = int(mat[4].sum())
+            if over:
+                self.metrics.over_limit_counter.inc(over)
+            return mat, errors
+        finally:
+            self.metrics.concurrent_checks.dec()
+            for name in ("V1Instance.GetRateLimits",
+                         "V1Instance.getLocalRateLimit"):
+                self.metrics.func_duration.labels(name=name).observe(
+                    time.perf_counter() - t0
+                )
+
     def _submit_local(self, reqs: List[RateLimitRequest], *, is_owner: bool):
         """Send a batch through the tick loop; wraps the future for await and
         handles GLOBAL owner-side queueing + metrics."""

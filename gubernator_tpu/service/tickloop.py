@@ -25,7 +25,11 @@ import time
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+_EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 
 # How many dispatched-but-unresolved windows may be in flight.  2 is full
 # double-buffering; a little deeper rides out D2H jitter.  The bound is the
@@ -45,8 +49,8 @@ def _complete(fut: Future, result) -> None:
         pass
 
 
-def _fail_waiters(batch, exc: Exception) -> None:
-    for _, fut in batch:
+def _fail_waiters(waiters, exc: Exception) -> None:
+    for _, fut in waiters:
         try:
             if not fut.cancelled():
                 fut.set_exception(exc)
@@ -91,16 +95,28 @@ class TickLoop:
         self, requests: Sequence[RateLimitRequest]
     ) -> "Future[List[RateLimitResponse]]":
         """Queue a request batch for the next tick."""
+        return self._enqueue("obj", list(requests), len(requests))
+
+    def submit_columns(self, cols) -> "Future":
+        """Queue a columnar batch; the future resolves to the
+        ``((5, n) matrix, errors)`` pair — no response objects anywhere
+        on the path (the transport fast path; engine must expose
+        submit_cols)."""
+        return self._enqueue("cols", cols, len(cols))
+
+    def _enqueue(self, kind: str, payload, n: int) -> Future:
         fut: Future = Future()
-        if not requests:
-            fut.set_result([])
+        if n == 0:
+            fut.set_result(
+                [] if kind == "obj" else (_EMPTY_MATRIX, {})
+            )
             return fut
         with self._cond:
             if not self._running:
                 fut.set_exception(RuntimeError("tick loop is shut down"))
                 return fut
-            self._pending.append((list(requests), fut))
-            self._pending_count += len(requests)
+            self._pending.append((kind, payload, n, fut))
+            self._pending_count += n
             if self.metrics is not None:
                 self.metrics.worker_queue_length.labels(
                     method="GetRateLimits", worker="0"
@@ -133,30 +149,63 @@ class TickLoop:
             self._flush(batch)
 
     def _flush(self, batch: List[tuple]) -> None:
-        reqs: List[RateLimitRequest] = []
-        for r, _ in batch:
-            reqs.extend(r)
+        """Dispatch one window.  Object and columnar submissions each
+        coalesce into (at most) one engine submission; both ride the same
+        resolver handoff and resolve together in one D2H."""
         t0 = time.perf_counter()
+        obj_items: List[tuple] = []   # (n, fut)
+        reqs: List[RateLimitRequest] = []
+        col_parts: List = []
+        col_items: List[tuple] = []
+        for kind, payload, n, fut in batch:
+            if kind == "cols":
+                col_parts.append(payload)
+                col_items.append((n, fut))
+            else:
+                reqs.extend(payload)
+                obj_items.append((n, fut))
+
         submit = getattr(self.engine, "submit", None)
         if submit is None:
             # Engines without the dispatch/resolve split (mesh engine):
-            # synchronous fallback, resolved inline.
+            # synchronous fallback, resolved inline; columnar submissions
+            # are not routed here (the fast path requires submit_cols).
+            if col_items:
+                _fail_waiters(
+                    col_items,
+                    RuntimeError("engine does not support columnar batches"),
+                )
             try:
                 out = self.engine.process(reqs)
             except Exception as e:  # engine failure fails every waiter
-                _fail_waiters(batch, e)
+                _fail_waiters(obj_items, e)
                 return
-            self._deliver(batch, reqs, out, time.perf_counter() - t0)
+            self._deliver(obj_items, out, len(reqs), time.perf_counter() - t0)
             return
-        try:
-            sb = submit(reqs)
-        except Exception as e:
-            _fail_waiters(batch, e)
+        subs = []
+        if reqs:
+            try:
+                subs.append(("obj", submit(reqs), obj_items, len(reqs)))
+            except Exception as e:
+                _fail_waiters(obj_items, e)
+        if col_parts:
+            from gubernator_tpu.ops.reqcols import ReqColumns
+
+            try:
+                subs.append((
+                    "cols",
+                    self.engine.submit_cols(ReqColumns.concat(col_parts)),
+                    col_items,
+                    sum(n for n, _ in col_items),
+                ))
+            except Exception as e:
+                _fail_waiters(col_items, e)
+        if not subs:
             return
         # Bounded handoff: blocks when PIPELINE_DEPTH windows are already
         # in flight (device behind), which is exactly the backpressure the
         # dispatch thread should feel.
-        self._resolve_q.put((sb, batch, reqs, time.perf_counter() - t0))
+        self._resolve_q.put((subs, time.perf_counter() - t0))
 
     def _resolve_loop(self) -> None:
         while True:
@@ -182,69 +231,96 @@ class TickLoop:
             try:
                 from gubernator_tpu.ops.engine import resolve_ticks
 
-                resolve_ticks(
-                    [h for sb, _, _, _ in items for h in sb.handles()]
-                )
+                resolve_ticks([
+                    h
+                    for subs, _ in items
+                    for _, sb, _, _ in subs
+                    for h in sb.handles()
+                ])
             except Exception:
-                pass  # per-window responses() below surfaces real errors
-            for sb, batch, reqs, dispatch_s in items:
-                # Everything below is guarded: an exception escaping this
-                # loop would kill the resolver thread and wedge the whole
-                # pipeline (dispatch eventually blocks on the bounded
-                # queue forever).
-                try:
-                    t1 = time.perf_counter()
-                    out = sb.responses()
-                    resolve_s = time.perf_counter() - t1
-                except Exception as e:
-                    _fail_waiters(batch, e)
-                    continue
-                try:
-                    self._deliver(batch, reqs, out, dispatch_s + resolve_s)
-                except Exception:
-                    logging.getLogger("gubernator.tickloop").exception(
-                        "tick delivery failed"
-                    )
+                pass  # per-window resolution below surfaces real errors
+            for subs, dispatch_s in items:
+                for kind, sb, waiters, n_reqs in subs:
+                    # Guarded: an exception escaping this loop would kill
+                    # the resolver thread and wedge the whole pipeline
+                    # (dispatch eventually blocks on the bounded queue).
+                    try:
+                        t1 = time.perf_counter()
+                        out = (
+                            sb.responses() if kind == "obj" else sb.matrix()
+                        )
+                        resolve_s = time.perf_counter() - t1
+                    except Exception as e:
+                        _fail_waiters(waiters, e)
+                        continue
+                    try:
+                        self._deliver_kind(
+                            kind, waiters, out, n_reqs,
+                            dispatch_s + resolve_s,
+                        )
+                    except Exception:
+                        logging.getLogger("gubernator.tickloop").exception(
+                            "tick delivery failed"
+                        )
             if stop:
                 return
 
-    def _deliver(self, batch, reqs, out, tick_s: float) -> None:
-        """Complete the waiters' futures + sync metrics.  ``tick_s`` is the
-        window's own engine time (dispatch + resolve), NOT wall time since
-        flush — under pipelining the latter would include time queued
-        behind earlier windows and misreport device health."""
-        if self.metrics is not None:
-            m = self.metrics
-            m.tick_duration.observe(tick_s)
-            m.tick_batch_size.observe(len(reqs))
-            m.worker_queue_length.labels(
-                method="GetRateLimits", worker="0"
-            ).set(self._pending_count)
-            m.command_counter.labels(
-                worker="0", method="GetRateLimits"
-            ).inc(len(reqs))
-            # Sync engine counter deltas (hit/miss on slot resolution,
-            # LRU evictions of unexpired buckets) into the catalog families.
-            hits = getattr(self.engine, "metric_hits", 0)
-            misses = getattr(self.engine, "metric_misses", 0)
-            unexp = getattr(self.engine, "metric_unexpired_evictions", 0)
-            if hits > self._synced_hits:
-                m.cache_access_count.labels(type="hit").inc(
-                    hits - self._synced_hits
-                )
-                self._synced_hits = hits
-            if misses > self._synced_misses:
-                m.cache_access_count.labels(type="miss").inc(
-                    misses - self._synced_misses
-                )
-                self._synced_misses = misses
-            if unexp > self._synced_unexpired:
-                m.unexpired_evictions.inc(unexp - self._synced_unexpired)
-                self._synced_unexpired = unexp
+    def _deliver_kind(self, kind, waiters, out, n_reqs, tick_s) -> None:
+        if kind == "obj":
+            self._deliver(waiters, out, n_reqs, tick_s)
+            return
+        mat, errors = out
+        self._metrics_sync(n_reqs, tick_s)
         off = 0
-        for r, fut in batch:
-            _complete(fut, out[off : off + len(r)])
-            off += len(r)
+        for n, fut in waiters:
+            errs = {
+                i - off: msg for i, msg in errors.items()
+                if off <= i < off + n
+            } if errors else {}
+            _complete(fut, (mat[:, off : off + n], errs))
+            off += n
+
+    def _deliver(self, waiters, out, n_reqs: int, tick_s: float) -> None:
+        """Complete object waiters' futures + sync metrics.  ``tick_s`` is
+        the window's own engine time (dispatch + resolve), NOT wall time
+        since flush — under pipelining the latter would include time
+        queued behind earlier windows and misreport device health."""
+        self._metrics_sync(n_reqs, tick_s)
+        off = 0
+        for n, fut in waiters:
+            _complete(fut, out[off : off + n])
+            off += n
+
+    def _metrics_sync(self, n_reqs: int, tick_s: float) -> None:
+        if self.metrics is None:
+            return
+        m = self.metrics
+        m.tick_duration.observe(tick_s)
+        m.tick_batch_size.observe(n_reqs)
+        m.worker_queue_length.labels(
+            method="GetRateLimits", worker="0"
+        ).set(self._pending_count)
+        m.command_counter.labels(
+            worker="0", method="GetRateLimits"
+        ).inc(n_reqs)
+        # Sync engine counter deltas (hit/miss on slot resolution,
+        # LRU evictions of unexpired buckets) into the catalog families.
+        hits = getattr(self.engine, "metric_hits", 0)
+        misses = getattr(self.engine, "metric_misses", 0)
+        unexp = getattr(self.engine, "metric_unexpired_evictions", 0)
+        if hits > self._synced_hits:
+            m.cache_access_count.labels(type="hit").inc(
+                hits - self._synced_hits
+            )
+            self._synced_hits = hits
+        if misses > self._synced_misses:
+            m.cache_access_count.labels(type="miss").inc(
+                misses - self._synced_misses
+            )
+            self._synced_misses = misses
+        if unexp > self._synced_unexpired:
+            m.unexpired_evictions.inc(unexp - self._synced_unexpired)
+            self._synced_unexpired = unexp
 
     def close(self) -> None:
         with self._cond:

@@ -75,5 +75,65 @@ def reqs_from_pb(ms: Iterable[pb.RateLimitReq]) -> List[RateLimitRequest]:
     return [req_from_pb(m) for m in ms]
 
 
+def columns_from_pb(ms) -> tuple:
+    """Parse a repeated RateLimitReq straight into a columnar batch —
+    the wire→device fast path with no per-request dataclasses.
+
+    Returns ``(cols, errors, special)``: per-item validation errors
+    (empty name/unique_key, the reference's error-in-item convention,
+    gubernator.go:208-216) and ``special`` = True when any item carries
+    GLOBAL behavior or metadata (trace context) — those need the
+    object-routing path.  ``created_at == 0`` means "server stamps now"
+    (matching V1Instance's object path, gubernator.go:218-220).
+    """
+    import numpy as np
+
+    from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns, pack_blob
+    from gubernator_tpu.types import Behavior
+
+    n = len(ms)
+    if n == 0:
+        return ReqColumns.empty(), {}, False
+    GLOBAL = int(Behavior.GLOBAL)
+    keys: List[bytes] = [b""] * n
+    hits = [0] * n
+    limit = [0] * n
+    duration = [0] * n
+    algorithm = [0] * n
+    behavior = [0] * n
+    created = [0] * n
+    burst = [0] * n
+    errors = {}
+    special = False
+    for i, m in enumerate(ms):
+        uk = m.unique_key
+        nm = m.name
+        if uk == "":
+            errors[i] = "field 'unique_key' cannot be empty"
+        elif nm == "":
+            errors[i] = "field 'namespace' cannot be empty"
+        else:
+            keys[i] = (nm + "_" + uk).encode()
+        hits[i] = m.hits
+        limit[i] = m.limit
+        duration[i] = m.duration
+        algorithm[i] = m.algorithm
+        b = behavior[i] = m.behavior
+        created[i] = m.created_at or CREATED_UNSET
+        burst[i] = m.burst
+        if (b & GLOBAL) or m.metadata:
+            special = True
+    a = lambda v: np.asarray(v, np.int64)  # noqa: E731
+    blob, offsets = pack_blob(keys)
+    return (
+        ReqColumns(
+            blob, offsets, a(hits), a(limit), a(duration), a(algorithm),
+            a(behavior), a(created), a(burst),
+        ),
+        errors,
+        special,
+    )
+
+
 def resps_to_pb(rs: Iterable[RateLimitResponse]) -> List[pb.RateLimitResp]:
     return [resp_to_pb(r) for r in rs]

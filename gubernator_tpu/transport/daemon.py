@@ -115,6 +115,32 @@ class V1Servicer:
         self.instance = instance
 
     async def GetRateLimits(self, request, context):
+        # Columnar fast path: wire → numpy columns → device → wire, no
+        # per-request Python objects.  Falls back to the object-routing
+        # path for clustered/GLOBAL/stored/erroneous traffic.
+        if self.instance.columns_fast_path_ok():
+            cols, errors, special = convert.columns_from_pb(request.requests)
+            if not special and not errors:
+                try:
+                    mat, errs = await self.instance.get_rate_limits_columns(
+                        cols
+                    )
+                except BatchTooLargeError as e:
+                    await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+                status, limit, remaining, reset = (
+                    mat[r].tolist() for r in range(4)
+                )
+                return pb.GetRateLimitsResp(responses=[
+                    pb.RateLimitResp(error=errs[i])
+                    if i in errs
+                    else pb.RateLimitResp(
+                        status=status[i],
+                        limit=limit[i],
+                        remaining=remaining[i],
+                        reset_time=reset[i],
+                    )
+                    for i in range(len(status))
+                ])
         try:
             out = await self.instance.get_rate_limits(
                 convert.reqs_from_pb(request.requests)

@@ -205,3 +205,32 @@ def test_mesh_store_via_instance_config():
         await inst.close()
 
     asyncio.run(run())
+
+
+def test_mesh_store_read_through_for_spilled_rows():
+    """A block-overflow spill's fresh slot re-resolves as known=1 on the
+    retry tick, but the device never wrote it — persisted state must
+    still read-through for those rows."""
+    from gubernator_tpu.store import MockStore
+
+    store = MockStore()
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=32, max_batch=2, store=store
+    )
+    # Four keys that all route to one shard: with max_batch=2, two spill.
+    shard0 = [
+        k for k in (f"sp{i}" for i in range(200))
+        if eng._shard_of(f"mesh_{k}") == 0
+    ][:4]
+    assert len(shard0) == 4
+    for k in shard0:
+        store.data[f"mesh_{k}"] = {
+            "key": f"mesh_{k}", "algorithm": 0, "limit": 10, "remaining": 3,
+            "remaining_f": 0.0, "duration": 60_000, "created_at": NOW,
+            "updated_at": 0, "burst": 10, "status": 0,
+            "expire_at": NOW + 60_000,
+        }
+    out = eng.process([req(k, hits=1, limit=10) for k in shard0], now=NOW)
+    # Every response reflects the persisted remaining=3 minus this hit —
+    # including the two spilled into the retry tick.
+    assert [r.remaining for r in out] == [2, 2, 2, 2]

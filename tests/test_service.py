@@ -390,3 +390,64 @@ async def test_forward_retry_exhaustion_and_self_upgrade():
         assert resp.remaining == 4
     finally:
         await c.stop()
+
+
+def test_columns_fast_path_matches_object_path():
+    """The wire→columns fast path must answer exactly like the object
+    path, and flip off the moment the instance stops being standalone."""
+    import asyncio
+
+    import numpy as np
+
+    from gubernator_tpu.ops.reqcols import ReqColumns
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitRequest
+
+    async def run():
+        conf = InstanceConfig(cache_size=256, tpu_max_batch=64)
+        inst = await V1Instance.create(conf)
+        assert inst.columns_fast_path_ok()
+        reqs = [
+            RateLimitRequest(name="fp", unique_key=str(i % 5), hits=1,
+                             limit=9, duration=60_000)
+            for i in range(20)
+        ]
+        obj = await inst.get_rate_limits(reqs)
+        mat, errors = await inst.get_rate_limits_columns(
+            ReqColumns.from_requests(reqs)
+        )
+        assert not errors
+        # Second pass over the same keys: columns observed object ticks.
+        assert mat[2].tolist() == [r.remaining - 4 for r in obj]
+
+        # Clustered instance: fast path must disable.
+        inst.set_peers([PeerInfo(grpc_address="10.0.0.1:81")])
+        assert not inst.columns_fast_path_ok()
+        await inst.close()
+
+    asyncio.run(run())
+
+
+def test_columns_from_pb_validation_and_special():
+    from gubernator_tpu.pb import gubernator_pb2 as pb
+    from gubernator_tpu.transport.convert import columns_from_pb
+    from gubernator_tpu.types import Behavior
+
+    ms = [
+        pb.RateLimitReq(name="a", unique_key="k", hits=1, limit=5,
+                        duration=1000),
+        pb.RateLimitReq(name="", unique_key="k2", hits=1),
+        pb.RateLimitReq(name="b", unique_key="", hits=1),
+    ]
+    cols, errors, special = columns_from_pb(ms)
+    assert not special
+    assert errors == {
+        1: "field 'namespace' cannot be empty",
+        2: "field 'unique_key' cannot be empty",
+    }
+    assert cols.key_bytes(0) == b"a_k"
+
+    ms2 = [pb.RateLimitReq(name="g", unique_key="k", hits=1,
+                           behavior=int(Behavior.GLOBAL))]
+    _, _, special = columns_from_pb(ms2)
+    assert special
