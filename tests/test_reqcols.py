@@ -126,3 +126,27 @@ def test_resolve_blob_matches_resolve_batch():
     s2, k2 = sm.resolve_batch(keys)
     assert s2.tolist() == s1.tolist()
     assert k2.tolist() == [1, 1, 1, 1]
+
+
+def test_tickloop_mixes_object_and_columnar_windows():
+    """Object and columnar submissions in one window coalesce, resolve in
+    one transfer, and each waiter gets its own kind of result."""
+    from gubernator_tpu.service.tickloop import TickLoop
+
+    eng = TickEngine(capacity=256, max_batch=64)
+    loop = TickLoop(eng, batch_wait=0.05, batch_limit=1000)
+    try:
+        obj_fut = loop.submit([req("mix", hits=2, limit=10)])
+        col_fut = loop.submit_columns(
+            ReqColumns.from_requests([req("mix", hits=3, limit=10)])
+        )
+        obj_out = obj_fut.result(timeout=10)
+        mat, errors = col_fut.result(timeout=10)
+        assert not errors
+        # Same key, same window: the two submissions serialized (object
+        # windows dispatch before columnar ones within a flush).
+        remains = sorted([obj_out[0].remaining, int(mat[2, 0])])
+        assert remains == [5, 8]
+    finally:
+        loop.close()
+        eng.close()
