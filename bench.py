@@ -285,8 +285,10 @@ def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=40
 def rung_herd(unique_dps, algo, label):
     """One hot key hit 4096× per tick (benchmark_test.go:122-147's
     thundering-herd scenario, scaled) — the merge fast path should hold it
-    near unique-key throughput for both algorithms."""
-    from gubernator_tpu.ops.engine import TickEngine
+    near unique-key throughput for both algorithms.  Measured the same
+    pipelined way as the unique-key rungs so the ratio compares like with
+    like."""
+    from gubernator_tpu.ops.engine import TickEngine, resolve_ticks
 
     now = 1_700_000_000_000
     batch = 4096
@@ -294,9 +296,14 @@ def rung_herd(unique_dps, algo, label):
     cols = _cols(np.zeros(batch, np.int64), 10**12, 3_600_000, algo)
     engine.process_columns(cols, now=now)  # install the key
     ticks = 50
+    pending = []
     t0 = time.perf_counter()
     for i in range(ticks):
-        engine.process_columns(cols, now=now + i)
+        pending.append(engine.submit_columns(cols, now + i))
+        if len(pending) >= 16:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
     dt = time.perf_counter() - t0
     dps = batch * ticks / dt
     return {
