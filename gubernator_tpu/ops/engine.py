@@ -254,6 +254,53 @@ def unpack_reqs(packed: jnp.ndarray) -> ReqBatch:
     )
 
 
+# Compact int32 request wire format: narrow fields ride one i32 row each,
+# 8-byte fields ride (lo, hi) i32 pairs — 76 B/request over the link
+# instead of the legacy int64 matrix's 96 (the engine's H2D is a top cost
+# both over remote links and on PCIe hosts at high tick rates).
+REQ32_NARROW = ("slot", "known", "algorithm", "behavior", "valid")
+REQ32_WIDE = (
+    "hits", "limit", "duration", "created_at", "burst",
+    "greg_exp", "greg_dur",
+)
+REQ32_INDEX = {name: i for i, name in enumerate(REQ32_NARROW)}
+for _j, _name in enumerate(REQ32_WIDE):
+    REQ32_INDEX[_name] = len(REQ32_NARROW) + 2 * _j  # the lo row; hi = +1
+REQ32_ROWS = len(REQ32_NARROW) + 2 * len(REQ32_WIDE)  # 19
+
+
+def pack_wide_rows(m32: np.ndarray, name: str, values, ix) -> None:
+    """Host-side write of an int64 column as its (lo, hi) i32 pair."""
+    v = np.asarray(values, np.int64)
+    r = REQ32_INDEX[name]
+    m32[r, ix] = (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    m32[r + 1, ix] = (v >> 32).astype(np.int32)
+
+
+def unpack_reqs_compact(m32: jnp.ndarray) -> ReqBatch:
+    """(19, B) int32 matrix → ReqBatch (device-side, inside jit)."""
+
+    def wide(name):
+        r = REQ32_INDEX[name]
+        lo = m32[r].astype(jnp.uint32).astype(jnp.int64)
+        return (m32[r + 1].astype(jnp.int64) << 32) | lo
+
+    return ReqBatch(
+        slot=m32[REQ32_INDEX["slot"]],
+        known=m32[REQ32_INDEX["known"]].astype(jnp.bool_),
+        hits=wide("hits"),
+        limit=wide("limit"),
+        duration=wide("duration"),
+        algorithm=m32[REQ32_INDEX["algorithm"]],
+        behavior=m32[REQ32_INDEX["behavior"]],
+        created_at=wide("created_at"),
+        burst=wide("burst"),
+        greg_exp=wide("greg_exp"),
+        greg_dur=wide("greg_dur"),
+        valid=m32[REQ32_INDEX["valid"]].astype(jnp.bool_),
+    )
+
+
 def pack_resp(resp: RespBatch) -> jnp.ndarray:
     """RespBatch → (5, B) int64 matrix (one D2H transfer)."""
     return jnp.stack(
@@ -265,6 +312,49 @@ def pack_resp(resp: RespBatch) -> jnp.ndarray:
             resp.over_limit.astype(jnp.int64),
         ]
     )
+
+
+def pack_resp_compact(resp: RespBatch) -> jnp.ndarray:
+    """RespBatch → (6, B) **int32** matrix: status, over_limit, and the
+    lo/hi halves of remaining and reset_time.
+
+    The response ``limit`` is always an echo of the request's limit
+    (reference algorithms.go returns rl.Limit after the limit-delta rules
+    update stored state to it), so the host reconstructs it from the
+    request columns instead of shipping 8 more bytes per decision — 24
+    B/decision instead of 40 over the link (TickHandle._finish rebuilds
+    the public (5, B) int64 contract)."""
+
+    def split(v):
+        return (
+            (v & jnp.int64(0xFFFFFFFF)).astype(jnp.int32),
+            (v >> 32).astype(jnp.int32),
+        )
+
+    rl, rh = split(resp.remaining)
+    tl, th = split(resp.reset_time)
+    return jnp.stack(
+        [resp.status, resp.over_limit.astype(jnp.int32), rl, rh, tl, th]
+    )
+
+
+def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
+    """Host inverse of :func:`pack_resp_compact`: (6, n) int32 in request
+    order + the request-order limit column → the (5, n) int64 response
+    matrix.  Values at per-item-error indices are unspecified (callers
+    overwrite those with error responses)."""
+
+    def join(lo, hi):
+        return (hi.astype(np.int64) << 32) | lo.astype(np.uint32).astype(np.int64)
+
+    n = raw.shape[1]
+    out = np.empty((5, n), np.int64)
+    out[0] = raw[0]
+    out[1] = limit_req[:n]
+    out[2] = join(raw[2], raw[3])
+    out[3] = join(raw[4], raw[5])
+    out[4] = raw[1]
+    return out
 
 
 def _apply_merged_followers(
@@ -531,7 +621,8 @@ def _apply_merged_followers_sorted(
 
 
 def make_tick_fn(capacity: int, merge_uniform: bool = True,
-                 layout: str = "columns", sorted_input: bool = False):
+                 layout: str = "columns", sorted_input: bool = False,
+                 compact_resp: bool = False, compact_req: bool = False):
     """Build the jittable tick: (state, reqs, now) → (state, responses).
 
     Pure function of its inputs (no clocks, no host state) so the driver can
@@ -696,8 +787,15 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
         return state, resp
 
     def tick_packed(state, packed: jnp.ndarray, now: jnp.ndarray):
-        state, resp = tick(state, unpack_reqs(packed), now)
-        return state, pack_resp(resp)
+        reqs = (
+            unpack_reqs_compact(packed)
+            if compact_req
+            else unpack_reqs(packed)
+        )
+        state, resp = tick(state, reqs, now)
+        return state, (
+            pack_resp_compact(resp) if compact_resp else pack_resp(resp)
+        )
 
     tick_packed.unpacked = tick
     return tick_packed
@@ -921,12 +1019,14 @@ def make_evict_fn(layout: str = "columns"):
 
 @functools.lru_cache(maxsize=None)
 def _jitted_tick(capacity: int, layout: str = "columns",
-                 sorted_input: bool = False):
+                 sorted_input: bool = False, compact_resp: bool = False,
+                 compact_req: bool = False):
     """Shared jitted tick per capacity: engines pass state explicitly, so an
     in-process multi-daemon cluster (the reference's test topology,
     cluster/cluster.go) compiles the kernel once, not once per daemon."""
     return jax.jit(
-        make_tick_fn(capacity, layout=layout, sorted_input=sorted_input),
+        make_tick_fn(capacity, layout=layout, sorted_input=sorted_input,
+                     compact_resp=compact_resp, compact_req=compact_req),
         donate_argnums=(0,),
     )
 
@@ -1171,9 +1271,10 @@ class TickHandle:
     """
 
     __slots__ = ("_engine", "_resp", "_n", "_inv", "errors", "_refs",
-                 "_slots_req", "_done")
+                 "_slots_req", "_limit_req", "_done")
 
-    def __init__(self, engine, resp, n, inv, errors, refs, slots_req):
+    def __init__(self, engine, resp, n, inv, errors, refs, slots_req,
+                 limit_req=None):
         self._engine = engine
         self._resp = resp
         self._n = n
@@ -1181,14 +1282,28 @@ class TickHandle:
         self.errors = errors
         self._refs = refs
         self._slots_req = slots_req
+        # Request-order limit column: the compact device response omits
+        # the limit echo (pack_resp_compact); reconstruction needs it.
+        # COPIED — the caller may reuse/rewrite its ReqColumns buffers
+        # between submit and resolve (the pipelining pattern), and this
+        # column is read at resolve time.
+        self._limit_req = (
+            None if limit_req is None
+            else np.array(limit_req[:n], np.int64, copy=True)
+        )
         self._done: Optional[np.ndarray] = None
 
     def _finish(self, raw: np.ndarray) -> None:
-        """Complete from an already-materialized (5, W) response matrix."""
+        """Complete from an already-materialized device response matrix:
+        (6, W) int32 compact (TickEngine's format — it compiles its tick
+        with compact_resp=True and always passes limit_req) or the
+        (5, W) int64 legacy layout used by engines that don't."""
         if self._done is not None:
             return
         # The [:, inv] un-permutes the slot-sorted batch.
         rm = raw[:, : self._n][:, self._inv]
+        if self._limit_req is not None:  # compact → public (5, n) int64
+            rm = unpack_resp_compact(rm, self._limit_req)
         eng = self._engine
         with eng._lock:
             eng.metric_over_limit += int(rm[4].sum())
@@ -1309,7 +1424,8 @@ class TickEngine:
         with jax.default_device(self.device):
             self.state = jax.tree.map(jnp.asarray, zeros(self.capacity))
         self._tick = _jitted_tick(self.capacity, self.layout,
-                                  sorted_input=True)
+                                  sorted_input=True, compact_resp=True,
+                                  compact_req=True)
         # Tick widths: one narrow program for typical service batches
         # (≤ the reference's 1000-item batch limit) plus the full width.
         # Singleton for small engines so test clusters don't pay an extra
@@ -1370,8 +1486,8 @@ class TickEngine:
         the 500ms peer batch_timeout, and triggers forward retries that
         double-count hits."""
         for w in self._widths:
-            m = np.zeros((len(REQ_ROWS), w), np.int64)
-            m[REQ_ROW_INDEX["slot"]] = self.capacity
+            m = np.zeros((REQ32_ROWS, w), np.int32)
+            m[REQ32_INDEX["slot"]] = self.capacity
             self.state, resp = self._tick(
                 self.state, jnp.asarray(m), jnp.int64(0)
             )
@@ -1542,8 +1658,8 @@ class TickEngine:
         # instead of paying for max_batch lanes of padding.  Both widths
         # are compiled at warmup.
         b = next(w for w in self._widths if w >= n)
-        m = np.zeros((len(REQ_ROWS), b), np.int64)
-        R = REQ_ROW_INDEX
+        m = np.zeros((REQ32_ROWS, b), np.int32)
+        R = REQ32_INDEX
         m[R["slot"]] = self.capacity  # padding scatters out of bounds
         errors: Dict[int, str] = {}
 
@@ -1555,8 +1671,12 @@ class TickEngine:
             for i in np.flatnonzero(greg):
                 try:
                     d = int(cols.duration[i])
-                    m[R["greg_exp"], i] = timeutil.gregorian_expiration(now, d)
-                    m[R["greg_dur"], i] = timeutil.gregorian_duration(now, d)
+                    pack_wide_rows(
+                        m, "greg_exp", timeutil.gregorian_expiration(now, d), i
+                    )
+                    pack_wide_rows(
+                        m, "greg_dur", timeutil.gregorian_duration(now, d), i
+                    )
                 except timeutil.GregorianError as exc:
                     errors[int(i)] = str(exc)
 
@@ -1619,23 +1739,25 @@ class TickEngine:
             self._read_through(cols.refs, rt_sel, slots, known, miss)
 
         # Vectorized pack: plain slices on the (typical) no-error batch,
-        # fancy-indexed writes when error rows must be skipped.
+        # fancy-indexed writes when error rows must be skipped.  Narrow
+        # fields write one i32 row; 8-byte fields write (lo, hi) pairs
+        # (pack_wide_rows) — the compact wire format unpack_reqs_compact
+        # reads on device.
         ix = slice(0, n) if sel is None else sel
 
-        def put(row, vals):
-            m[R[row], ix] = vals
-
-        put("slot", slots)
-        put("known", known)
-        put("hits", cols.hits[ix])
-        put("limit", cols.limit[ix])
-        put("duration", cols.duration[ix])
-        put("algorithm", cols.algorithm[ix])
-        put("behavior", cols.behavior[ix])
+        m[R["slot"], ix] = slots
+        m[R["known"], ix] = known
+        m[R["algorithm"], ix] = cols.algorithm[ix]
+        m[R["behavior"], ix] = cols.behavior[ix]
+        m[R["valid"], ix] = 1
+        pack_wide_rows(m, "hits", cols.hits[ix], ix)
+        pack_wide_rows(m, "limit", cols.limit[ix], ix)
+        pack_wide_rows(m, "duration", cols.duration[ix], ix)
         ca = cols.created_at[ix]
-        put("created_at", np.where(ca != CREATED_UNSET, ca, now))
-        put("burst", cols.burst[ix])
-        put("valid", 1)
+        pack_wide_rows(
+            m, "created_at", np.where(ca != CREATED_UNSET, ca, now), ix
+        )
+        pack_wide_rows(m, "burst", cols.burst[ix], ix)
         # Sort the batch by slot (stable: same-slot requests keep arrival
         # order, the duplicate-sequencing contract).  The tick's
         # sorted-input path then does all segment math with neighbor
@@ -1714,11 +1836,14 @@ class TickEngine:
                 )
             self._pending.clear()
             slots_req = (
-                packed[REQ_ROW_INDEX["slot"], :n][inv]
+                packed[REQ32_INDEX["slot"], :n][inv].astype(np.int64)
                 if self.store is not None
                 else None
             )
-            handle = TickHandle(self, resp, n, inv, errors, cols.refs, slots_req)
+            handle = TickHandle(
+                self, resp, n, inv, errors, cols.refs, slots_req,
+                limit_req=cols.limit,
+            )
             if self.store is not None:
                 handle.result()
             return handle

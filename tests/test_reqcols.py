@@ -150,3 +150,17 @@ def test_tickloop_mixes_object_and_columnar_windows():
     finally:
         loop.close()
         eng.close()
+
+
+def test_handle_limit_snapshot_survives_caller_mutation():
+    """The compact response reconstructs the limit echo from the request
+    columns; the handle must snapshot them — callers reuse their buffers
+    between submit and resolve (the pipelining pattern)."""
+    eng = TickEngine(capacity=64, max_batch=32)
+    cols = ReqColumns.from_requests([req("lim", hits=1, limit=100)])
+    h = eng.submit_columns(cols, now=NOW)
+    cols.limit[:] = 777  # caller rewrites its buffer before resolving
+    rm, errors = h.result()
+    assert not errors
+    assert rm[1, 0] == 100  # the limit at submit time, not 777
+    eng.close()
