@@ -177,10 +177,11 @@ def pack_request_matrix(
     behav=None,
     greg=None,
 ) -> None:
-    """Vectorized fill of the packed request matrix: one attribute pass
-    over ``requests`` plus one fancy-indexed numpy write per row.  Shared
-    by all three engines (single-chip build_batch, mesh shards, GLOBAL
-    mesh) so the REQ_ROWS layout has exactly one packing implementation.
+    """Vectorized fill of the packed LEGACY int64 request matrix: one
+    attribute pass over ``requests`` plus one fancy-indexed numpy write
+    per row.  Remaining user: the GLOBAL mesh engine (global_mesh.py) —
+    the single-chip and sharded tick engines moved to the compact int32
+    wire format (:func:`pack_request_matrix32` / REQ32 layout).
 
     ``m`` is (len(REQ_ROWS), B), or (N, len(REQ_ROWS), B) with ``nodes``
     giving the leading-axis index per request.  ``behav`` optionally
@@ -269,12 +270,86 @@ for _j, _name in enumerate(REQ32_WIDE):
 REQ32_ROWS = len(REQ32_NARROW) + 2 * len(REQ32_WIDE)  # 19
 
 
+def split_i64(v: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int64 → (lo, hi) int32 pair — THE host-side definition of the
+    compact wire format's wide encoding (device inverse:
+    unpack_reqs_compact; host inverse: join_i32_pair)."""
+    return (
+        (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32),
+        (v >> 32).astype(np.int32),
+    )
+
+
 def pack_wide_rows(m32: np.ndarray, name: str, values, ix) -> None:
     """Host-side write of an int64 column as its (lo, hi) i32 pair."""
-    v = np.asarray(values, np.int64)
+    lo, hi = split_i64(np.asarray(values, np.int64))
     r = REQ32_INDEX[name]
-    m32[r, ix] = (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-    m32[r + 1, ix] = (v >> 32).astype(np.int32)
+    m32[r, ix] = lo
+    m32[r + 1, ix] = hi
+
+
+def pack_request_matrix32(
+    m32: np.ndarray,
+    sel,
+    requests,
+    slots,
+    known,
+    now: int,
+    *,
+    nodes=None,
+    greg=None,
+) -> None:
+    """Compact-format counterpart of :func:`pack_request_matrix`: fill a
+    (REQ32_ROWS, B) — or (N, REQ32_ROWS, B) with ``nodes`` — int32 matrix
+    from request objects.  One attribute pass + one vectorized write per
+    row (wide fields as lo/hi pairs)."""
+    if len(requests) == 0:
+        return
+    R = REQ32_INDEX
+
+    def put(row, vals):
+        if nodes is None:
+            m32[R[row], sel] = vals
+        else:
+            m32[nodes, R[row], sel] = vals
+
+    def put_wide(name, vals):
+        if nodes is None:
+            pack_wide_rows(m32, name, vals, sel)
+            return
+        v = np.asarray(vals, np.int64)
+        lo, hi = split_i64(v)
+        r = REQ32_INDEX[name]
+        m32[nodes, r, sel] = lo
+        m32[nodes, r + 1, sel] = hi
+
+    behav, hits, limit, duration, algo, created, burst = zip(*(
+        (int(r.behavior), r.hits, r.limit, r.duration, int(r.algorithm),
+         r.created_at if r.created_at is not None else now, r.burst)
+        for r in requests
+    ))
+    put("slot", slots)
+    put("known", known)
+    put("algorithm", algo)
+    put("behavior", behav)
+    put("valid", 1)
+    put_wide("hits", hits)
+    put_wide("limit", limit)
+    put_wide("duration", duration)
+    put_wide("created_at", created)
+    put_wide("burst", burst)
+    if greg is not None:
+        put_wide("greg_exp", greg[0])
+        put_wide("greg_dur", greg[1])
+
+
+def join_i32_pair(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Host-side (lo, hi) int32 pair → int64 (the compact wire format's
+    inverse; two's complement preserved for negatives)."""
+    return (
+        (np.asarray(hi).astype(np.int64) << 32)
+        | np.asarray(lo).astype(np.uint32).astype(np.int64)
+    )
 
 
 def unpack_reqs_compact(m32: jnp.ndarray) -> ReqBatch:
@@ -343,16 +418,12 @@ def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
     order + the request-order limit column → the (5, n) int64 response
     matrix.  Values at per-item-error indices are unspecified (callers
     overwrite those with error responses)."""
-
-    def join(lo, hi):
-        return (hi.astype(np.int64) << 32) | lo.astype(np.uint32).astype(np.int64)
-
     n = raw.shape[1]
     out = np.empty((5, n), np.int64)
     out[0] = raw[0]
     out[1] = limit_req[:n]
-    out[2] = join(raw[2], raw[3])
-    out[3] = join(raw[4], raw[5])
+    out[2] = join_i32_pair(raw[2], raw[3])
+    out[3] = join_i32_pair(raw[4], raw[5])
     out[4] = raw[1]
     return out
 

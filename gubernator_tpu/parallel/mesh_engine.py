@@ -49,19 +49,20 @@ from gubernator_tpu.ops.engine import (
     EVICT_CHUNK,
     ITEM_INT_ROWS,
     READBACK_ROWS,
-    REQ_ROWS,
-    REQ_ROW_INDEX,
+    REQ32_INDEX,
+    REQ32_ROWS,
     RESTORE_CHUNK,
     SNAP_FIELDS,
     device_dead_mask,
     items_from_columns,
+    join_i32_pair,
     make_evict_fn,
     make_install_fn,
     make_layout_choice,
     make_readback_fn,
     make_restore_fn,
     make_tick_fn,
-    pack_request_matrix,
+    pack_request_matrix32,
     pad_pow2,
     resolve_gregorian,
     select_reclaim_victims,
@@ -112,7 +113,13 @@ class ShardedOps:
         self.block_sharding2 = NamedSharding(mesh, P("shard", None))
         self.block_sharding3 = NamedSharding(mesh, P("shard", None, None))
 
-        tick = make_tick_fn(local_capacity, layout=layout)
+        # Compact int32 wire formats (engine.REQ32 / pack_resp_compact):
+        # per-shard request blocks cross host->devices at 76 B/request and
+        # responses return at 24 — the same transfer win the single-chip
+        # engine gets, per PCIe lane on real multi-chip hosts.
+        tick = make_tick_fn(
+            local_capacity, layout=layout, compact_req=True, compact_resp=True
+        )
         evict = make_evict_fn(layout)
         install = make_install_fn(layout)
         restore = make_restore_fn(layout)
@@ -241,8 +248,8 @@ class MeshTickEngine:
 
     def _warmup(self) -> None:
         """Compile the sharded tick at startup (see TickEngine._warmup)."""
-        m = np.zeros((self.n_shards, len(REQ_ROWS), self.max_batch), np.int64)
-        m[:, REQ_ROW_INDEX["slot"], :] = self.local_capacity
+        m = np.zeros((self.n_shards, REQ32_ROWS, self.max_batch), np.int32)
+        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
         self.state, resp = self.ops.tick(
             self.state, self.ops.put3(m), jnp.int64(0)
         )
@@ -377,7 +384,6 @@ class MeshTickEngine:
         batch per shard (reclaim + retry on a full shard), and every
         request-matrix row is one fancy-indexed numpy write."""
         b = self.max_batch
-        R = REQ_ROW_INDEX
         self._tick_count += 1
 
         # One attribute pass: gregorian, key, shard.
@@ -471,11 +477,12 @@ class MeshTickEngine:
         if self.store is not None and len(miss_sel):
             self._read_through(requests, idx, shards, slots, known, miss_sel, now)
 
-        m = np.zeros((self.n_shards, len(REQ_ROWS), b), np.int64)
-        m[:, R["slot"], :] = self.local_capacity
+        m = np.zeros((self.n_shards, REQ32_ROWS, b), np.int32)
+        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
         sh, ps = shards[sel], pos[sel]
-        pack_request_matrix(
-            m, ps, [requests[idx[j]] for j in sel], slots[sel], known[sel],
+        sel_reqs = [requests[idx[j]] for j in sel]
+        pack_request_matrix32(
+            m, ps, sel_reqs, slots[sel], known[sel],
             now, nodes=sh,
             greg=(np.asarray(greg_e, np.int64)[sel],
                   np.asarray(greg_d, np.int64)[sel]),
@@ -486,17 +493,17 @@ class MeshTickEngine:
         )
         self._pending.clear()
         self._pending.update(g_spill_new.tolist())
-        rm = np.asarray(resp)  # (n_shards, 5, B)
-        self.metric_over_limit += int(rm[sh, 4, ps].sum())
+        rm = np.asarray(resp)  # (n_shards, 6, B) int32 (compact format)
+        self.metric_over_limit += int(rm[sh, 1, ps].sum())
         if self.store is not None:
             self._write_through(requests, idx, sel, shards, slots, now)
-        status, limit_o, remaining, reset = (
-            rm[sh, r, ps].tolist() for r in range(4)
-        )
+        status = rm[sh, 0, ps].tolist()
+        remaining = join_i32_pair(rm[sh, 2, ps], rm[sh, 3, ps]).tolist()
+        reset = join_i32_pair(rm[sh, 4, ps], rm[sh, 5, ps]).tolist()
         for t, j in enumerate(sel):
             out[idx[j]] = RateLimitResponse(
                 status=status[t],
-                limit=limit_o[t],
+                limit=sel_reqs[t].limit,  # the echo (see pack_resp_compact)
                 remaining=remaining[t],
                 reset_time=reset[t],
             )
