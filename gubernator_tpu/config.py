@@ -78,6 +78,10 @@ class Config:
     # Bucket-table storage: "auto" picks the Pallas row layout on TPU for
     # tables it fits (ops/rowtable.py), "columns"/"row" force one.
     tpu_table_layout: str = "auto"   # GUBER_TPU_TABLE_LAYOUT
+    # Background reclamation (TTL sweep + LRU selection on a reclaimer
+    # thread instead of the serving path): "auto" enables it for tables
+    # >= 2^18 slots; "on"/"off" force.  GUBER_TPU_BG_RECLAIM
+    tpu_bg_reclaim: str = "auto"
     # GLOBAL reconciliation over the device mesh (collectives data plane,
     # parallel/global_mesh.py): N logical peer-nodes; 0 = gRPC loops only.
     # Node index -1 = auto (jax.process_index(), the multi-host identity).
@@ -316,6 +320,7 @@ def setup_daemon_config(
         instance_id=r.str_("GUBER_INSTANCE_ID"),
         tpu_max_batch=r.int_("GUBER_TPU_MAX_BATCH", 4096),
         tpu_table_layout=r.str_("GUBER_TPU_TABLE_LAYOUT", "auto"),
+        tpu_bg_reclaim=r.str_("GUBER_TPU_BG_RECLAIM", "auto"),
         tpu_mesh_shards=r.int_("GUBER_TPU_MESH_SHARDS", 0),
         tpu_platform=r.str_("GUBER_TPU_PLATFORM"),
         tpu_global_mesh_nodes=r.int_("GUBER_TPU_GLOBAL_MESH_NODES", 0),
@@ -326,6 +331,11 @@ def setup_daemon_config(
     )
     conf.set_defaults()
 
+    if conf.tpu_bg_reclaim not in ("auto", "on", "off"):
+        raise ValueError(
+            f"GUBER_TPU_BG_RECLAIM must be auto, on, or off; "
+            f"got {conf.tpu_bg_reclaim!r}"
+        )
     if conf.local_picker_hash not in ("fnv1", "fnv1a"):
         raise ValueError(
             f"GUBER_PEER_PICKER_HASH is invalid; choose one of 'fnv1', 'fnv1a'"

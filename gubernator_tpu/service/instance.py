@@ -69,6 +69,7 @@ class InstanceConfig:
     tpu_mesh_shards: int = 0             # 0 = single-chip engine
     tpu_platform: str = ""               # force jax platform ("cpu" for tests)
     tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
+    tpu_bg_reclaim: str = "auto"         # background reclamation (engine.py)
     # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
     # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
     # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
@@ -95,6 +96,7 @@ class InstanceConfig:
             tpu_mesh_shards=conf.tpu_mesh_shards,
             tpu_platform=conf.tpu_platform,
             tpu_table_layout=conf.tpu_table_layout,
+            tpu_bg_reclaim=conf.tpu_bg_reclaim,
             tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
             tpu_global_mesh_node=conf.tpu_global_mesh_node,
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
@@ -125,11 +127,13 @@ def _make_engine(conf: InstanceConfig):
         )
     from gubernator_tpu.ops.engine import TickEngine
 
+    bg = {"auto": None, "on": True, "off": False}[conf.tpu_bg_reclaim]
     return TickEngine(
         capacity=conf.cache_size,
         max_batch=conf.tpu_max_batch,
         store=conf.store,
         table_layout=conf.tpu_table_layout,
+        bg_reclaim=bg,
     )
 
 

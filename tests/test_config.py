@@ -106,3 +106,16 @@ def test_layout_choice_rules():
     assert make_layout_choice("columns", 1 << 16, cpu, 4096) == "columns"
     with pytest.raises(ValueError):
         make_layout_choice("rows", 1 << 16, cpu, 4096)
+
+
+def test_bg_reclaim_knob(monkeypatch):
+    import pytest
+
+    from gubernator_tpu.config import setup_daemon_config
+
+    monkeypatch.setenv("GUBER_TPU_BG_RECLAIM", "off")
+    conf = setup_daemon_config()
+    assert conf.config.tpu_bg_reclaim == "off"
+    monkeypatch.setenv("GUBER_TPU_BG_RECLAIM", "sometimes")
+    with pytest.raises(ValueError, match="GUBER_TPU_BG_RECLAIM"):
+        setup_daemon_config()
