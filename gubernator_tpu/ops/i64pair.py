@@ -1,0 +1,178 @@
+"""int64 arithmetic on (lo, hi) int32 pairs — TPU-native 64-bit math.
+
+TPU v5e has no native 64-bit integer unit: XLA's X64 rewriter emulates
+every i64 op with i32 pairs *generically*, and (worse) Mosaic refuses to
+compile Pallas kernels at all under ``jax_enable_x64``.  The tick's
+wire formats already store every 64-bit field as explicit (lo, hi) i32
+columns (ops/buckets.py STATE_DTYPES); this module supplies arithmetic
+directly on that representation so the whole bucket transition can run
+in pure int32 — inside a Pallas kernel or in plain XLA — with bit-exact
+two's-complement i64 semantics (adds/subs/muls wrap exactly like Go's
+int64, reference algorithms.go:37-493).
+
+Representation: ``(lo, hi)`` int32 arrays of any (matching) shape; ``lo``
+holds the unsigned low 32 bits (bit pattern in an int32), ``hi`` the
+signed high word.  All functions are shape-polymorphic and elementwise.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+_SIGN = jnp.int32(-0x80000000)  # 0x80000000 bit pattern
+_M16 = jnp.int32(0xFFFF)
+
+
+class I64(NamedTuple):
+    """(lo, hi) int32 pair holding one int64 per element."""
+
+    lo: jnp.ndarray
+    hi: jnp.ndarray
+
+
+def from_i32(x) -> I64:
+    """Sign-extend an int32 array to a pair."""
+    x = jnp.asarray(x, I32)
+    return I64(x, x >> 31)
+
+
+def const(v: int, like) -> I64:
+    """Broadcast a Python int constant to the shape of ``like`` (an array)."""
+    shape = jnp.shape(like)
+    lo = jnp.full(shape, _lo32(v), I32)
+    hi = jnp.full(shape, _hi32(v), I32)
+    return I64(lo, hi)
+
+
+def _lo32(v: int) -> int:
+    u = v & 0xFFFFFFFF
+    return u - 0x100000000 if u >= 0x80000000 else u
+
+
+def _hi32(v: int) -> int:
+    u = (v >> 32) & 0xFFFFFFFF
+    return u - 0x100000000 if u >= 0x80000000 else u
+
+
+def _ult(a, b):
+    """Unsigned 32-bit a < b on int32 bit patterns (sign-bias trick)."""
+    return (a ^ _SIGN) < (b ^ _SIGN)
+
+
+def add(a: I64, b: I64) -> I64:
+    lo = a.lo + b.lo
+    carry = _ult(lo, a.lo).astype(I32)
+    return I64(lo, a.hi + b.hi + carry)
+
+
+def sub(a: I64, b: I64) -> I64:
+    lo = a.lo - b.lo
+    borrow = _ult(a.lo, lo).astype(I32)
+    return I64(lo, a.hi - b.hi - borrow)
+
+
+def neg(a: I64) -> I64:
+    return sub(const(0, a.lo), a)
+
+
+def eq(a: I64, b: I64):
+    return (a.lo == b.lo) & (a.hi == b.hi)
+
+
+def ne(a: I64, b: I64):
+    return (a.lo != b.lo) | (a.hi != b.hi)
+
+
+def lt(a: I64, b: I64):
+    return (a.hi < b.hi) | ((a.hi == b.hi) & _ult(a.lo, b.lo))
+
+
+def le(a: I64, b: I64):
+    return (a.hi < b.hi) | ((a.hi == b.hi) & ~_ult(b.lo, a.lo))
+
+
+def gt(a: I64, b: I64):
+    return lt(b, a)
+
+
+def ge(a: I64, b: I64):
+    return le(b, a)
+
+
+def is_zero(a: I64):
+    return (a.lo == 0) & (a.hi == 0)
+
+
+def is_neg(a: I64):
+    return a.hi < 0
+
+
+def select(c, a: I64, b: I64) -> I64:
+    return I64(jnp.where(c, a.lo, b.lo), jnp.where(c, a.hi, b.hi))
+
+
+def max_(a: I64, b: I64) -> I64:
+    return select(lt(a, b), b, a)
+
+
+def min_(a: I64, b: I64) -> I64:
+    return select(lt(a, b), a, b)
+
+
+def _umul32(a, b):
+    """Unsigned 32x32 -> 64 multiply on int32 bit patterns, via 16-bit
+    limbs (TPU has no widening multiply)."""
+    a0 = a & _M16
+    a1 = (a >> 16) & _M16
+    b0 = b & _M16
+    b1 = (b >> 16) & _M16
+    p00 = a0 * b0            # < 2^32, exact as bit pattern
+    p01 = a0 * b1            # < 2^32
+    p10 = a1 * b0            # < 2^32
+    p11 = a1 * b1            # < 2^32
+    # lo = p00 + ((p01 + p10) << 16), tracking carries into hi.
+    mid = (p01 & _M16) + (p10 & _M16) + ((p00 >> 16) & _M16)
+    lo = (p00 & _M16) | (mid << 16)
+    hi = p11 + ((p01 >> 16) & _M16) + ((p10 >> 16) & _M16) \
+        + ((mid >> 16) & _M16)
+    return lo, hi
+
+
+def mul(a: I64, b: I64) -> I64:
+    """Wrapping i64 multiply (Go int64 overflow semantics)."""
+    lo, hi = _umul32(a.lo, b.lo)
+    hi = hi + a.lo * b.hi + a.hi * b.lo  # wrapping i32 muls feed high word
+    return I64(lo, hi)
+
+
+def shr(a: I64, n: int) -> I64:
+    """Arithmetic shift right by a static 0 <= n < 64."""
+    if n == 0:
+        return a
+    if n < 32:
+        lo = ((a.lo >> n) & ((1 << (32 - n)) - 1)) | (a.hi << (32 - n))
+        return I64(lo, a.hi >> n)
+    return I64(a.hi >> (n - 32), a.hi >> 31)
+
+
+def to_np(a: I64):
+    """Host-side: pair -> numpy int64 (for tests)."""
+    import numpy as np
+
+    lo = np.asarray(a.lo).astype(np.int64) & 0xFFFFFFFF
+    hi = np.asarray(a.hi).astype(np.int64)
+    return (hi << 32) | lo
+
+
+def from_np(v):
+    """Host-side: numpy int64 -> pair (for tests)."""
+    import numpy as np
+
+    v = np.asarray(v, np.int64)
+    lo = (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    hi = (v >> 32).astype(np.int32)
+    return I64(jnp.asarray(lo), jnp.asarray(hi))

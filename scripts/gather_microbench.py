@@ -1,0 +1,165 @@
+"""Microbenchmark: per-row DMA gather variants on the real TPU.
+
+Round 3 measured gathers plateauing at 41-58M rows/s regardless of ring
+depth (docs/tpu-performance.md) while the 4x-unrolled scatter reaches
+290-330M rows/s.  The 50M decisions/s kernel target needs the gather to
+do better — this sweep asks where the plateau actually comes from:
+
+  * ring depth x unroll grid (issue-rate vs latency binding)
+  * half-row split DMAs (2x transactions, same bytes -> transaction-bound?)
+  * two-row DMAs (same transactions, 2x bytes -> byte-bound?)
+  * sorted vs random slot order (HBM row-buffer locality)
+
+Methodology per docs: chained fori_loop, differential (t(2N)-t(N))/N,
+loop-carried dependence so XLA cannot hoist the gather out of the loop.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CAP = 1 << 20
+B = 1 << 15
+ROW_W = 128
+N = 150
+
+_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def _ring_loop(body_start, b, ring, unroll):
+    u = unroll if b % unroll == 0 and b >= 2 * ring else 1
+
+    def body(g, _):
+        for k in range(u):
+            j = g * u + k
+
+            @pl.when(j >= ring)
+            def _(j=j):
+                body_start(j - ring).wait()
+
+            body_start(j).start()
+        return 0
+
+    lax.fori_loop(0, b // u, body, 0)
+
+    def drain(j, _):
+        body_start(j).wait()
+        return 0
+
+    lax.fori_loop(max(0, b - ring), b, drain, 0)
+
+
+def make_gather(ring, unroll, split=1, rows_per_dma=1):
+    """split: each row fetched as `split` separate DMAs of ROW_W//split
+    words.  rows_per_dma: fetch this many consecutive table rows per DMA
+    (output has B*rows_per_dma rows; only B are 'useful')."""
+
+    def kernel(slots_ref, table_ref, out_ref, sems):
+        b = slots_ref.shape[0]
+        w = ROW_W // split
+
+        def start(j):
+            row = j // split
+            part = j % split if split > 1 else 0
+            return pltpu.make_async_copy(
+                table_ref.at[
+                    pl.ds(slots_ref[row], rows_per_dma),
+                    pl.ds(part * w, w),
+                ],
+                out_ref.at[pl.ds(row * rows_per_dma, rows_per_dma),
+                           pl.ds(part * w, w)],
+                sems.at[lax.rem(j, ring)],
+            )
+
+        _ring_loop(start, b * split, ring, unroll)
+
+    def gather(table, slots):
+        b = slots.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((b * rows_per_dma, ROW_W),
+                                   lambda t, *_: (0, 0)),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((ring,))],
+        )
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((b * rows_per_dma, ROW_W),
+                                               jnp.int32),
+                compiler_params=_PARAMS,
+                interpret=False,
+            )(slots, table)
+
+    return gather
+
+
+def diff_time(gather, table, slots, label):
+    def chain(iters):
+        @jax.jit
+        def run(carry):
+            def body(i, c):
+                out = gather(table, (slots + (c & 1)) % jnp.int32(CAP))
+                return out[0, 0]
+
+            return lax.fori_loop(0, iters, body, carry)
+
+        return run
+
+    runs = {}
+    for k in (N, 2 * N):
+        r = chain(k)
+        np.asarray(r(jnp.int32(0)))  # compile + warm
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = r(jnp.int32(0))
+            np.asarray(out)
+            best = min(best, time.perf_counter() - t0)
+        runs[k] = best
+    per = (runs[2 * N] - runs[N]) / N
+    rate = B / max(per, 1e-12) / 1e6
+    print(f"{label:52s} {per * 1e6:9.1f} us/gather ({rate:7.1f} M rows/s)",
+          flush=True)
+    return per
+
+
+def main():
+    print(f"devices: {jax.devices()}  B={B} CAP={CAP} N={N}", flush=True)
+    rng = np.random.default_rng(0)
+    table = jnp.zeros((CAP + 1, ROW_W), jnp.int32)
+    idx_rand = jnp.asarray(rng.permutation(CAP)[:B].astype(np.int32))
+    idx_sorted = jnp.sort(idx_rand)
+
+    base = None
+    for ring in (32, 64, 128, 256):
+        for unroll in (4, 8, 16):
+            g = make_gather(ring, unroll)
+            t = diff_time(g, table, idx_sorted,
+                          f"gather ring={ring} unroll={unroll} sorted")
+            if ring == 32 and unroll == 4:
+                base = t
+
+    # order sensitivity at the best plain config
+    g = make_gather(128, 8)
+    diff_time(g, table, idx_rand, "gather ring=128 unroll=8 RANDOM order")
+
+    # transaction-bound probe: 2x DMAs, same bytes
+    g = make_gather(128, 8, split=2)
+    diff_time(g, table, idx_sorted, "gather ring=128 unroll=8 half-row x2")
+
+    # byte-bound probe: same DMAs, 2x bytes
+    g = make_gather(128, 8, rows_per_dma=2)
+    diff_time(g, table, idx_sorted, "gather ring=128 unroll=8 two-row DMA")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Follow-up: why does the plain-gather microbench read ~21M rows/s when
+round 3's breakdown claimed 0.6-0.8 ms (41-58M rows/s) for the tick's
+gather?  Compare the production gather under different carry styles and
+decompose the production tick.
+"""
+
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gubernator_tpu.ops import rowtable
+from gubernator_tpu.ops.rowtable import gather_rows, scatter_rows
+
+CAP = 1 << 20
+B = 1 << 15
+N = 150
+
+
+def diff(chain_builder, label, per_iter_rows=B):
+    runs = {}
+    for k in (N, 2 * N):
+        r = chain_builder(k)
+        np.asarray(jax.tree.leaves(r())[0].ravel()[:1])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = r()
+            np.asarray(jax.tree.leaves(out)[0].ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        runs[k] = best
+    per = (runs[2 * N] - runs[N]) / N
+    print(f"{label:56s} {per * 1e6:9.1f} us ({per_iter_rows / max(per, 1e-12) / 1e6:7.1f} M rows/s)",
+          flush=True)
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    table0 = jnp.zeros((CAP + 1, rowtable.ROW_W), jnp.int32)
+    slots = jnp.asarray(np.sort(rng.permutation(CAP)[:B]).astype(np.int32))
+    rows0 = jnp.asarray(
+        rng.integers(0, 1 << 20, (B, rowtable.ROW_W)).astype(np.int32))
+
+    # A: gather with CARRIED TABLE, fixed slots (production-tick shape:
+    # the table is the loop carry; slots loop-invariant).
+    def mk_a(iters):
+        @jax.jit
+        def run(table=table0):
+            def body(i, tab):
+                out = gather_rows(tab, slots)
+                # cheap table mutation so the carry changes: write row 0
+                tab = lax.dynamic_update_slice(tab, out[:1], (0, 0))
+                return tab
+
+            return lax.fori_loop(0, iters, body, table)
+
+        return lambda: run()
+
+    diff(mk_a, "A: gather, carried table, fixed slots")
+
+    # B: gather + full scatter back (the tick's state motion, no compute)
+    def mk_b(iters):
+        @jax.jit
+        def run(table=table0):
+            def body(i, tab):
+                out = gather_rows(tab, slots)
+                return scatter_rows(tab, slots, out)
+
+            return lax.fori_loop(0, iters, body, table)
+
+        return lambda: run()
+
+    diff(mk_b, "B: gather + scatter, carried table")
+
+    # C: scatter only, carried table, fixed rows
+    def mk_c(iters):
+        @jax.jit
+        def run(table=table0):
+            def body(i, tab):
+                return scatter_rows(tab, slots, rows0)
+
+            return lax.fori_loop(0, iters, body, table)
+
+        return lambda: run()
+
+    diff(mk_c, "C: scatter only, carried table, fixed rows")
+
+    # D: gather, fixed table, slots varied by scalar carry (yesterday's
+    # harness) — checks whether the slot perturbation itself is the gap.
+    def mk_d(iters):
+        @jax.jit
+        def run(c0=jnp.int32(0)):
+            def body(i, c):
+                out = gather_rows(table0, (slots + (c & 1)) & (CAP - 1))
+                return out[0, 0]
+
+            return lax.fori_loop(0, iters, body, c0)
+
+        return lambda: run()
+
+    diff(mk_d, "D: gather, fixed table, carry-perturbed slots")
+
+    # E: production full tick (row layout, sorted input) for reference
+    from gubernator_tpu.ops.engine import (
+        REQ_ROWS, REQ_ROW_INDEX as rows, make_tick_fn)
+    from gubernator_tpu.ops.rowtable import RowState
+
+    now = 1_700_000_000_000
+    m = np.zeros((len(REQ_ROWS), B), np.int64)
+    m[rows["slot"]] = np.asarray(slots)
+    m[rows["known"]] = 1
+    m[rows["hits"]] = 1
+    m[rows["limit"]] = 1_000_000
+    m[rows["duration"]] = 3_600_000
+    m[rows["algorithm"]] = rng.integers(0, 2, B)
+    m[rows["created_at"]] = now
+    m[rows["valid"]] = 1
+    packed = jnp.asarray(m)
+    tick = make_tick_fn(CAP, layout="row", sorted_input=True)
+    state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
+
+    def mk_e(iters):
+        @jax.jit
+        def run(st=state0):
+            def body(i, carry):
+                s, _ = carry
+                return tick(s, packed, jnp.int64(now) + i)
+
+            return lax.fori_loop(
+                0, iters, body, (st, jnp.zeros((5, B), jnp.int64)))
+
+        return lambda: run()
+
+    diff(mk_e, "E: production tick (row, sorted_input)")
+
+
+if __name__ == "__main__":
+    main()

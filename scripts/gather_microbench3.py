@@ -1,0 +1,119 @@
+"""Ring/unroll sweep for per-row DMA gather in the clean harness (carried
+table, fixed slots — the production-tick dependence shape).  The
+round-4 first sweep ran with carry-perturbed slots, which itself costs
+~2x and masked any ring/unroll signal.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CAP = 1 << 20
+B = 1 << 15
+ROW_W = 128
+N = 150
+
+_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def make_gather(ring, unroll):
+    def kernel(slots_ref, table_ref, out_ref, sems):
+        b = out_ref.shape[0]
+        u = unroll
+
+        def start(j):
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(slots_ref[j], 1), :],
+                out_ref.at[pl.ds(j, 1), :],
+                sems.at[lax.rem(j, ring)],
+            )
+
+        def body(g, _):
+            for k in range(u):
+                j = g * u + k
+
+                @pl.when(j >= ring)
+                def _(j=j):
+                    start(j - ring).wait()
+
+                start(j).start()
+            return 0
+
+        lax.fori_loop(0, b // u, body, 0)
+
+        def drain(j, _):
+            start(j).wait()
+            return 0
+
+        lax.fori_loop(max(0, b - ring), b, drain, 0)
+
+    def gather(table, slots):
+        b = slots.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((b, ROW_W), lambda t, *_: (0, 0)),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((ring,))],
+        )
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((b, ROW_W), jnp.int32),
+                compiler_params=_PARAMS,
+                interpret=False,
+            )(slots, table)
+
+    return gather
+
+
+def diff(gather, table0, slots, label):
+    def chain(iters):
+        @jax.jit
+        def run(table=table0):
+            def body(i, tab):
+                out = gather(tab, slots)
+                return lax.dynamic_update_slice(tab, out[:1], (0, 0))
+
+            return lax.fori_loop(0, iters, body, table)
+
+        return run
+
+    runs = {}
+    for k in (N, 2 * N):
+        r = chain(k)
+        np.asarray(r()[:1, :1])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = r()
+            np.asarray(out[:1, :1])
+            best = min(best, time.perf_counter() - t0)
+        runs[k] = best
+    per = (runs[2 * N] - runs[N]) / N
+    print(f"{label:40s} {per * 1e6:9.1f} us ({B / max(per, 1e-12) / 1e6:7.1f} M rows/s)",
+          flush=True)
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    table0 = jnp.zeros((CAP + 1, ROW_W), jnp.int32)
+    slots = jnp.asarray(np.sort(rng.permutation(CAP)[:B]).astype(np.int32))
+
+    for ring in (32, 64, 128, 256):
+        for unroll in (4, 8, 16, 32):
+            if unroll > ring:
+                continue
+            g = make_gather(ring, unroll)
+            diff(g, table0, slots, f"gather ring={ring} unroll={unroll}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""Probe: does splitting row-gather DMAs across SEPARATE semaphore
+arrays (1, 2, 4, 8 independent rings) raise read throughput?  If Mosaic
+binds DMA queues per semaphore array, multiple arrays = queue
+parallelism and reads should scale; if reads are a hardware descriptor
+pipeline limit, flat.  Also re-times the scatter the same way.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CAP = 1 << 20
+B = 1 << 15
+ROW_W = 128
+N = 300
+RING = 32
+
+_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def make_kernel(n_arrays, write=False):
+    def kernel(slots_ref, table_ref, out_ref, *sems):
+        b = B
+
+        def start(a, j):
+            if write:
+                return pltpu.make_async_copy(
+                    out_ref.at[pl.ds(j, 1), :],
+                    table_ref.at[pl.ds(slots_ref[j], 1), :],
+                    sems[a].at[lax.rem(j // n_arrays, RING)],
+                )
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(slots_ref[j], 1), :],
+                out_ref.at[pl.ds(j, 1), :],
+                sems[a].at[lax.rem(j // n_arrays, RING)],
+            )
+
+        span = RING * n_arrays
+
+        def body(g, _):
+            for a in range(n_arrays):
+                j = g * n_arrays + a
+
+                @pl.when(j >= span)
+                def _(a=a, j=j):
+                    start(a, j - span).wait()
+
+                start(a, j).start()
+            return 0
+
+        big_g = b // n_arrays
+        lax.fori_loop(0, big_g, body, 0)
+
+        for a in range(n_arrays):
+            def drain(g, _, a=a):
+                start(a, g * n_arrays + a).wait()
+                return 0
+
+            lax.fori_loop(max(0, big_g - RING), big_g, drain, 0)
+
+    return kernel
+
+
+def run_config(n_arrays, write, table0, slots, rows_in):
+    kernel = make_kernel(n_arrays, write)
+    sem_shapes = [pltpu.SemaphoreType.DMA((RING,)) for _ in range(n_arrays)]
+    if write:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec((B, ROW_W), lambda t, *_: (0, 0)),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=sem_shapes,
+        )
+
+        def op(table, slots):
+            with jax.enable_x64(False):
+                # args: slots(prefetch), rows(block), table(ANY) -> table out
+                return pl.pallas_call(
+                    lambda s, r, t, o, *sem: kernel(s, o, r, *sem),
+                    grid_spec=grid_spec,
+                    out_shape=jax.ShapeDtypeStruct((CAP + 1, ROW_W), jnp.int32),
+                    input_output_aliases={2: 0},
+                    compiler_params=_PARAMS,
+                    interpret=False,
+                )(slots, rows_in, table)
+
+        def chain(iters):
+            @jax.jit
+            def run(table=table0):
+                def body(i, tab):
+                    return op(tab, slots)
+
+                return lax.fori_loop(0, iters, body, table)
+
+            return run
+    else:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((B, ROW_W), lambda t, *_: (0, 0)),
+            scratch_shapes=sem_shapes,
+        )
+
+        def op(table, slots):
+            with jax.enable_x64(False):
+                return pl.pallas_call(
+                    kernel,
+                    grid_spec=grid_spec,
+                    out_shape=jax.ShapeDtypeStruct((B, ROW_W), jnp.int32),
+                    compiler_params=_PARAMS,
+                    interpret=False,
+                )(slots, table)
+
+        def chain(iters):
+            @jax.jit
+            def run(table=table0):
+                def body(i, tab):
+                    out = op(tab, slots)
+                    return lax.dynamic_update_slice(tab, out[:1], (0, 0))
+
+                return lax.fori_loop(0, iters, body, table)
+
+            return run
+
+    runs = {}
+    for k in (N, 2 * N):
+        r = chain(k)
+        np.asarray(r()[:1, :1])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = r()
+            np.asarray(out[:1, :1])
+            best = min(best, time.perf_counter() - t0)
+        runs[k] = best
+    per = (runs[2 * N] - runs[N]) / N
+    kind = "scatter" if write else "gather"
+    print(f"{kind} arrays={n_arrays:2d} ring={RING}x{n_arrays:2d}"
+          f"   {per * 1e6:9.1f} us ({B / max(per, 1e-12) / 1e6:7.1f} M rows/s)",
+          flush=True)
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    table0 = jnp.zeros((CAP + 1, ROW_W), jnp.int32)
+    slots = jnp.asarray(np.sort(rng.permutation(CAP)[:B]).astype(np.int32))
+    rows_in = jnp.asarray(
+        rng.integers(0, 1 << 20, (B, ROW_W)).astype(np.int32))
+
+    for n in (1, 2, 4, 8):
+        try:
+            run_config(n, False, table0, slots, rows_in)
+        except Exception as e:
+            print(f"gather arrays={n} FAIL {str(e).splitlines()[0][:80]}",
+                  flush=True)
+    for n in (1, 4, 8):
+        try:
+            run_config(n, True, table0, slots, rows_in)
+        except Exception as e:
+            print(f"scatter arrays={n} FAIL {str(e).splitlines()[0][:80]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Decompose the tick's XLA 'middle': everything between the row gather
+and the row scatter.  Round-4 measurements put gather at ~750us and
+scatter at ~413us of a 2.3ms tick, so ~1.1ms is extracts + x64
+transition + merge machinery + packing.  Which part?
+"""
+
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gubernator_tpu.ops.buckets import (
+    BucketState, ReqBatch, bucket_transition)
+from gubernator_tpu.ops.rowtable import (
+    logical_to_matrix, matrix_to_logical)
+from gubernator_tpu.ops.engine import (
+    REQ_ROWS, REQ_ROW_INDEX as rows, unpack_reqs, pack_resp)
+
+CAP = 1 << 20
+B = 1 << 15
+N = 300
+NOW = 1_700_000_000_000
+
+
+def diff(mk, label):
+    runs = {}
+    for k in (N, 2 * N):
+        r = mk(k)
+        np.asarray(jax.tree.leaves(r())[0].ravel()[:1])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = r()
+            np.asarray(jax.tree.leaves(out)[0].ravel()[:1])
+            best = min(best, time.perf_counter() - t0)
+        runs[k] = best
+    per = (runs[2 * N] - runs[N]) / N
+    print(f"{label:52s} {per * 1e6:9.1f} us", flush=True)
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    mat0 = jnp.asarray(
+        rng.integers(0, 1 << 20, (B, 128)).astype(np.int32))
+
+    m = np.zeros((len(REQ_ROWS), B), np.int64)
+    m[rows["slot"]] = np.sort(rng.permutation(CAP)[:B])
+    m[rows["known"]] = 1
+    m[rows["hits"]] = 1
+    m[rows["limit"]] = 1_000_000
+    m[rows["duration"]] = 3_600_000
+    m[rows["algorithm"]] = rng.integers(0, 2, B)
+    m[rows["created_at"]] = NOW
+    m[rows["valid"]] = 1
+    packed = jnp.asarray(m)
+    reqs0 = jax.jit(unpack_reqs)(packed)
+    reqs0 = jax.tree.map(jnp.asarray, reqs0)
+
+    # 1: matrix -> logical -> matrix round-trip (extract/bitcast cost)
+    def mk1(iters):
+        @jax.jit
+        def run(mat=mat0):
+            def body(i, mt):
+                st = matrix_to_logical(mt)
+                return logical_to_matrix(st)
+
+            return lax.fori_loop(0, iters, body, mat)
+
+        return lambda: run()
+
+    diff(mk1, "matrix_to_logical + logical_to_matrix")
+
+    # 2: transition on logical columns (x64), carried state
+    st0 = matrix_to_logical(mat0)
+    st0 = jax.tree.map(jnp.asarray, jax.jit(lambda: st0)())
+
+    def mk2(iters):
+        @jax.jit
+        def run(st=st0):
+            def body(i, s):
+                new, resp = bucket_transition(jnp.int64(NOW) + i, s, reqs0)
+                return new
+
+            return lax.fori_loop(0, iters, body, st)
+
+        return lambda: run()
+
+    diff(mk2, "bucket_transition (x64 logical)")
+
+    # 3: unpack_reqs per-iteration (it is hoisted in the rung; real cost)
+    def mk3(iters):
+        @jax.jit
+        def run(c=jnp.int64(0)):
+            def body(i, c):
+                r = unpack_reqs(packed)
+                return c + r.hits[0] + i
+
+            return lax.fori_loop(0, iters, body, c)
+
+        return lambda: run()
+
+    diff(mk3, "unpack_reqs (loop-carried consumer)")
+
+    # 4: pack_resp
+    from gubernator_tpu.ops.buckets import RespBatch
+    resp0 = RespBatch(
+        status=jnp.zeros(B, jnp.int32),
+        limit=jnp.ones(B, jnp.int64),
+        remaining=jnp.ones(B, jnp.int64),
+        reset_time=jnp.full(B, NOW, jnp.int64),
+        over_limit=jnp.zeros(B, jnp.bool_),
+    )
+    resp0 = jax.tree.map(jnp.asarray, resp0)
+
+    def mk4(iters):
+        @jax.jit
+        def run(c=jnp.int64(0)):
+            def body(i, c):
+                p = pack_resp(resp0._replace(
+                    remaining=resp0.remaining + c))
+                return c + p[0, 0]
+
+            return lax.fori_loop(0, iters, body, c)
+
+        return lambda: run()
+
+    diff(mk4, "pack_resp")
+
+    # 5: transition + round-trip together (the whole middle, no merge)
+    def mk5(iters):
+        @jax.jit
+        def run(mat=mat0):
+            def body(i, mt):
+                st = matrix_to_logical(mt)
+                new, resp = bucket_transition(jnp.int64(NOW) + i, st, reqs0)
+                mt2 = logical_to_matrix(new)
+                return mt2
+
+            return lax.fori_loop(0, iters, body, mat)
+
+        return lambda: run()
+
+    diff(mk5, "middle: extract + transition + repack")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Probe: does Mosaic support per-lane dynamic gather from VMEM?
+
+If `jnp.take` / indexing with a vector of per-lane indices compiles and
+runs fast inside a TPU Pallas kernel, the dense-streaming tick (state
+blocks streamed sequentially + request alignment via gather) becomes
+viable.  Tries 1-D take, take_along_axis on 2-D, and measures rate.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S = 1 << 14  # lanes per block
+
+
+def probe(name, kernel, *args, expect=None):
+    try:
+        out = kernel(*args)
+        out = np.asarray(out)
+        ok = "OK" if expect is None or np.array_equal(out, expect) else "WRONG"
+        print(f"{name:44s} {ok}", flush=True)
+        return ok == "OK"
+    except Exception as e:
+        msg = str(e).split("\n")[0][:110]
+        print(f"{name:44s} FAIL {msg}", flush=True)
+        return False
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 1 << 20, S).astype(np.int32)
+    idx = rng.integers(0, S, S).astype(np.int32)
+
+    # 1-D per-lane take
+    def k1(src_ref, idx_ref, out_ref):
+        out_ref[...] = jnp.take(src_ref[...], idx_ref[...], axis=0)
+
+    def run1(src, idx):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                k1,
+                out_shape=jax.ShapeDtypeStruct((S,), jnp.int32),
+                interpret=False,
+            )(src, idx)
+
+    probe("1-D jnp.take (S=16K)", run1, jnp.asarray(src), jnp.asarray(idx),
+          expect=src[idx])
+
+    # 2-D take_along_axis on lane dim (8 sublanes x S lanes)
+    src2 = rng.integers(0, 1 << 20, (8, 512)).astype(np.int32)
+    idx2 = rng.integers(0, 512, (8, 512)).astype(np.int32)
+
+    def k2(src_ref, idx_ref, out_ref):
+        out_ref[...] = jnp.take_along_axis(src_ref[...], idx_ref[...], axis=1)
+
+    def run2(a, b):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                k2,
+                out_shape=jax.ShapeDtypeStruct((8, 512), jnp.int32),
+                interpret=False,
+            )(a, b)
+
+    probe("2-D take_along_axis lanes", run2, jnp.asarray(src2),
+          jnp.asarray(idx2), expect=np.take_along_axis(src2, idx2, 1))
+
+    # sublane-dim gather: dense rows selected by per-row index
+    src3 = rng.integers(0, 1 << 20, (512, 128)).astype(np.int32)
+    idx3 = rng.integers(0, 512, 512).astype(np.int32)
+
+    def k3(src_ref, idx_ref, out_ref):
+        out_ref[...] = jnp.take(src_ref[...], idx_ref[...], axis=0)
+
+    def run3(a, b):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                k3,
+                out_shape=jax.ShapeDtypeStruct((512, 128), jnp.int32),
+                interpret=False,
+            )(a, b)
+
+    probe("2-D row take (sublane gather)", run3, jnp.asarray(src3),
+          jnp.asarray(idx3), expect=src3[idx3])
+
+    # speed: chained 1-D takes
+    def kspeed(src_ref, idx_ref, out_ref):
+        x = src_ref[...]
+        i = idx_ref[...]
+        for _ in range(8):
+            x = jnp.take(x, i, axis=0)
+        out_ref[...] = x
+
+    def runs(a, b):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                kspeed,
+                out_shape=jax.ShapeDtypeStruct((S,), jnp.int32),
+                interpret=False,
+            )(a, b)
+
+    if probe("8x chained 1-D take", runs, jnp.asarray(src), jnp.asarray(idx)):
+        r = jax.jit(lambda a, b: runs(a, b))
+        np.asarray(r(jnp.asarray(src), jnp.asarray(idx)))
+        N = 300
+        @jax.jit
+        def chain(a, b):
+            def body(i, x):
+                return runs(x, b)
+            return lax.fori_loop(0, N, body, a)
+        np.asarray(chain(jnp.asarray(src), jnp.asarray(idx)))
+        t0 = time.perf_counter()
+        np.asarray(chain(jnp.asarray(src), jnp.asarray(idx)))
+        dt = time.perf_counter() - t0
+        per_take = dt / (N * 8)
+        print(f"  per 16K-lane take: {per_take*1e6:.1f} us "
+              f"({S / per_take / 1e6:.0f} M elem/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

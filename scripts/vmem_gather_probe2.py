@@ -1,0 +1,99 @@
+"""Probe 2: tpu.dynamic_gather via jnp.take_along_axis with x.shape ==
+idx.shape, axis 0 (sublanes) and axis 1 (lanes) — correctness at several
+depths, then throughput of the sublane variant (the dense-tick alignment
+primitive: out[i,j] = run[idx[i,j]] after broadcasting run across lanes).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def mk(axis, shape):
+    def k(x_ref, i_ref, o_ref):
+        o_ref[...] = jnp.take_along_axis(x_ref[...], i_ref[...], axis=axis)
+
+    def run(x, i):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                k,
+                out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+                interpret=False,
+            )(x, i)
+
+    return run
+
+
+def probe(name, axis, shape, idx_hi):
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 1 << 20, shape).astype(np.int32)
+    i = rng.integers(0, idx_hi, shape).astype(np.int32)
+    want = np.take_along_axis(x, i, axis=axis)
+    try:
+        got = np.asarray(mk(axis, shape)(jnp.asarray(x), jnp.asarray(i)))
+        ok = "OK" if np.array_equal(got, want) else "WRONG"
+    except Exception as e:
+        ok = "FAIL " + str(e).split("\n")[0][:90]
+    print(f"{name:52s} {ok}", flush=True)
+    return ok == "OK"
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    probe("axis0 (8,128) idx<8", 0, (8, 128), 8)
+    probe("axis0 (256,128) idx<256", 0, (256, 128), 256)
+    probe("axis0 (2048,128) idx<2048", 0, (2048, 128), 2048)
+    probe("axis1 (8,128) idx<128", 1, (8, 128), 128)
+    probe("axis1 (8,512) idx<512", 1, (8, 512), 512)
+    probe("axis1 (128,1024) idx<1024", 1, (128, 1024), 1024)
+
+    # throughput: sublane gather on (R,128) chained
+    for R in (256, 2048):
+        shape = (R, 128)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.integers(0, 1 << 20, shape).astype(np.int32))
+        i = jnp.asarray(rng.integers(0, R, shape).astype(np.int32))
+
+        def kk(x_ref, i_ref, o_ref):
+            v = x_ref[...]
+            ii = i_ref[...]
+            for _ in range(8):
+                v = jnp.take_along_axis(v, ii, axis=0)
+            o_ref[...] = v
+
+        def one(x, i):
+            with jax.enable_x64(False):
+                return pl.pallas_call(
+                    kk,
+                    out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+                    interpret=False,
+                )(x, i)
+
+        try:
+            N = 200
+
+            @jax.jit
+            def chain(x, i):
+                def body(t, v):
+                    return one(v, i)
+
+                return lax.fori_loop(0, N, body, x)
+
+            np.asarray(chain(x, i))
+            t0 = time.perf_counter()
+            np.asarray(chain(x, i))
+            dt = time.perf_counter() - t0
+            per = dt / (N * 8)
+            el = shape[0] * shape[1]
+            print(f"axis0 ({R},128) per-gather: {per*1e6:9.1f} us "
+                  f"({el / per / 1e6:8.0f} M elem/s)", flush=True)
+        except Exception as e:
+            print(f"axis0 ({R},128) speed FAIL {str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""Property tests: i64-pair and triple-f32 arithmetic vs numpy oracles.
+
+These are the primitives the parts-native bucket transition is built
+from (ops/i64pair.py, ops/tfloat.py); pair ops must be bit-exact i64,
+triple ops must be >= f64-class precise on the engine's envelope.
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops import i64pair as p64
+from gubernator_tpu.ops import tfloat as tf
+
+RNG = np.random.default_rng(7)
+
+
+def rand_i64(n, lo=-(2**62), hi=2**62):
+    specials = np.array(
+        [0, 1, -1, 2**31 - 1, 2**31, -(2**31), 2**32 - 1, 2**32,
+         -(2**32), 2**52, -(2**52), 2**62 - 1, -(2**62),
+         1_700_000_000_000, 3_600_000],
+        np.int64,
+    )
+    vals = RNG.integers(lo, hi, n - len(specials), dtype=np.int64)
+    return np.concatenate([specials, vals])
+
+
+class TestPair:
+    def setup_method(self, _):
+        self.a = rand_i64(512)
+        self.b = rand_i64(512)[::-1].copy()
+        self.pa = p64.from_np(self.a)
+        self.pb = p64.from_np(self.b)
+
+    def test_roundtrip(self):
+        np.testing.assert_array_equal(p64.to_np(self.pa), self.a)
+
+    def test_add_sub_neg(self):
+        np.testing.assert_array_equal(
+            p64.to_np(p64.add(self.pa, self.pb)), self.a + self.b)
+        np.testing.assert_array_equal(
+            p64.to_np(p64.sub(self.pa, self.pb)), self.a - self.b)
+        np.testing.assert_array_equal(p64.to_np(p64.neg(self.pa)), -self.a)
+
+    def test_mul_wraps(self):
+        np.testing.assert_array_equal(
+            p64.to_np(p64.mul(self.pa, self.pb)),
+            (self.a * self.b))  # numpy int64 mul wraps two's-complement
+
+    def test_compares(self):
+        for name, op in [("lt", np.less), ("le", np.less_equal),
+                         ("gt", np.greater), ("ge", np.greater_equal),
+                         ("eq", np.equal), ("ne", np.not_equal)]:
+            got = np.asarray(getattr(p64, name)(self.pa, self.pb))
+            np.testing.assert_array_equal(got, op(self.a, self.b), err_msg=name)
+
+    def test_minmax_select(self):
+        np.testing.assert_array_equal(
+            p64.to_np(p64.max_(self.pa, self.pb)), np.maximum(self.a, self.b))
+        np.testing.assert_array_equal(
+            p64.to_np(p64.min_(self.pa, self.pb)), np.minimum(self.a, self.b))
+        c = self.a > 0
+        np.testing.assert_array_equal(
+            p64.to_np(p64.select(c, self.pa, self.pb)),
+            np.where(c, self.a, self.b))
+
+    def test_shr(self):
+        for n in (0, 1, 24, 31, 32, 48, 63):
+            np.testing.assert_array_equal(
+                p64.to_np(p64.shr(self.pa, n)), self.a >> n, err_msg=str(n))
+
+    def test_from_i32_const(self):
+        x = RNG.integers(-(2**31), 2**31, 64, dtype=np.int64)
+        np.testing.assert_array_equal(
+            p64.to_np(p64.from_i32(x.astype(np.int32))), x)
+        np.testing.assert_array_equal(
+            p64.to_np(p64.const(-(5 << 40), np.zeros(4, np.int32))),
+            np.full(4, -(5 << 40)))
+
+
+class TestTriple:
+    def test_pair_roundtrip_exact(self):
+        v = rand_i64(512, -(2**62), 2**62)
+        t = tf.from_pair(p64.from_np(v))
+        np.testing.assert_array_equal(tf.to_np(t), v.astype(np.float64))
+        back = p64.to_np(tf.floor_to_pair(t))
+        np.testing.assert_array_equal(back, v)
+
+    def test_add_precision(self):
+        # drip accumulation shape: integer counts + small fractions
+        a = RNG.uniform(-1e12, 1e12, 512)
+        b = RNG.uniform(-1e3, 1e3, 512)
+        got = tf.to_np(tf.add(tf.from_np(a), tf.from_np(b)))
+        want = a + b
+        # ~60-bit precision: within a couple of f64 ulps (XLA's own TPU
+        # f64 emulation is a float32 pair, ~49 bits — far looser).
+        np.testing.assert_allclose(got, want, rtol=5e-16)
+
+    def test_div_exact_when_representable(self):
+        # golden-suite rates: duration / limit with exact quotients
+        dur = np.array([30_000, 60_000, 1_000, 5_000, 3_600_000] * 8,
+                       np.float64)
+        lim = np.array([10, 10, 4, 5, 1000] * 8, np.float64)
+        got = tf.to_np(tf.div(tf.from_np(dur), tf.from_np(lim)))
+        np.testing.assert_array_equal(got, dur / lim)
+
+    def test_div_precision_random(self):
+        a = RNG.uniform(1, 1e15, 512)
+        b = RNG.uniform(1, 1e9, 512)
+        got = tf.to_np(tf.div(tf.from_np(a), tf.from_np(b)))
+        np.testing.assert_allclose(got, a / b, rtol=5e-16)
+
+    def test_floor(self):
+        x = np.concatenate([
+            RNG.uniform(-1e9, 1e9, 500),
+            np.array([0.0, -0.0, 0.5, -0.5, 1.0, -1.0, 2**40 + 0.5,
+                      -(2**40) - 0.5, 3.9999999, -3.0000001, 1e-300, 7.0,
+                      # within half an f32 ulp of an integer: the raw
+                      # per-part fraction sum misrounds without the
+                      # compare-verified correction step
+                      4.0 - 1e-9, -4.0 + 1e-9, 4.0 + 1e-9, -4.0 - 1e-9,
+                      1e6 - 1e-7, -(1e6 - 1e-7)]),
+        ])
+        got = p64.to_np(tf.floor_to_pair(tf.from_np(x)))
+        np.testing.assert_array_equal(got, np.floor(x).astype(np.int64))
+
+    def test_compares(self):
+        a = RNG.uniform(-100, 100, 512)
+        b = np.where(RNG.random(512) < 0.3, a, RNG.uniform(-100, 100, 512))
+        ta, tb = tf.from_np(a), tf.from_np(b)
+        np.testing.assert_array_equal(np.asarray(tf.ge(ta, tb)), a >= b)
+        np.testing.assert_array_equal(np.asarray(tf.gt(ta, tb)), a > b)
+        np.testing.assert_array_equal(np.asarray(tf.ge_zero(ta)), a >= 0)
+        np.testing.assert_array_equal(np.asarray(tf.gt_zero(ta)), a > 0)
+
+    def test_compare_pair(self):
+        a = RNG.uniform(-1e6, 1e6, 512)
+        v = RNG.integers(-(10**6), 10**6, 512, dtype=np.int64)
+        ta = tf.from_np(a)
+        pv = p64.from_np(v)
+        np.testing.assert_array_equal(
+            np.asarray(tf.ge_pair(ta, pv)), a >= v.astype(np.float64))
+        np.testing.assert_array_equal(
+            np.asarray(tf.gt_pair(ta, pv)), a > v.astype(np.float64))
+
+    def test_mul_f(self):
+        a = RNG.uniform(-1e9, 1e9, 512)
+        f = RNG.uniform(-1e3, 1e3, 512).astype(np.float32)
+        got = tf.to_np(tf.mul_f(tf.from_np(a), f))
+        want = a * f.astype(np.float64)
+        np.testing.assert_allclose(got, want, rtol=1e-15)
+
+    def test_leaky_drip_scenario(self):
+        # 10 tokens / 30s -> rate 3000 ms/token; drip accumulation must
+        # stay integer-exact over many steps (the golden sequences).
+        rate = tf.div(tf.from_np(np.full(8, 30_000.0)),
+                      tf.from_np(np.full(8, 10.0)))
+        rem = tf.from_np(np.full(8, 7.0))
+        for elapsed in (3000.0, 6000.0, 1500.0, 4500.0):
+            leak = tf.div(tf.from_np(np.full(8, elapsed)), rate)
+            rem = tf.add(rem, leak)
+        np.testing.assert_array_equal(
+            tf.to_np(rem), np.full(8, 7 + (3000 + 6000 + 1500 + 4500) / 3000))
