@@ -63,30 +63,32 @@ def rung_kernel():
     from jax import lax
 
     from gubernator_tpu.ops.buckets import BucketState
-    from gubernator_tpu.ops.engine import REQ_ROWS, REQ_ROW_INDEX as rows, make_tick_fn
+    from gubernator_tpu.ops.engine import (
+        REQ32_INDEX as R32, REQ32_ROWS, make_layout_choice)
+    from gubernator_tpu.ops.rowtable import RowState
+    from gubernator_tpu.ops.tick32 import make_tick32_fn
 
     capacity = 1 << 20
     batch = 1 << 15
     now = 1_700_000_000_000
 
+    # Compact i32 request matrix, slot-sorted unique keys — exactly what
+    # engine._build_cols hands the production unique-batch program (the
+    # fused Pallas tick on the row layout, ops/fusedtick.py).
     rng = np.random.default_rng(0)
-    m = np.zeros((len(REQ_ROWS), batch), np.int64)
-    m[rows["slot"]] = np.sort(rng.permutation(capacity)[:batch])
-    m[rows["known"]] = 1
-    m[rows["hits"]] = 1
-    m[rows["limit"]] = 1_000_000
-    m[rows["duration"]] = 3_600_000
-    m[rows["algorithm"]] = rng.integers(0, 2, batch)
-    m[rows["created_at"]] = now
-    m[rows["valid"]] = 1
+    m = np.zeros((REQ32_ROWS, batch), np.int32)
+    m[R32["slot"]] = np.sort(rng.permutation(capacity)[:batch])
+    m[R32["known"]] = 1
+    m[R32["algorithm"]] = rng.integers(0, 2, batch)
+    m[R32["valid"]] = 1
+    from gubernator_tpu.ops.engine import pack_wide_rows
 
-    # Measure the production hot path: the row layout on TPU (Pallas
-    # per-row DMA, ops/rowtable.py), columns elsewhere.
-    from gubernator_tpu.ops.engine import make_layout_choice
-    from gubernator_tpu.ops.rowtable import RowState
+    for name, v in (("hits", 1), ("limit", 1_000_000),
+                    ("duration", 3_600_000), ("created_at", now)):
+        pack_wide_rows(m, name, np.full(batch, v, np.int64), slice(None))
 
     layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
-    tick = make_tick_fn(capacity, layout=layout, sorted_input=True)
+    tick = make_tick32_fn(capacity, layout)
     zeros = RowState.zeros if layout == "row" else BucketState.zeros
     state = jax.tree.map(jnp.asarray, zeros(capacity))
     packed = jnp.asarray(m)
@@ -109,7 +111,7 @@ def rung_kernel():
                 return tick(s, packed, jnp.int64(now) + i)
 
             return lax.fori_loop(
-                0, iters, body, (st, jnp.zeros((5, batch), jnp.int64))
+                0, iters, body, (st, jnp.zeros((6, batch), jnp.int32))
             )
 
         return run
@@ -128,11 +130,24 @@ def rung_kernel():
 
     for r in runs.values():  # compile + warm
         np.asarray(r(state)[1][:1, :1])
-    per_tick = (timed(runs[2 * n]) - timed(runs[n])) / n
-    if per_tick <= 0:
-        # Tunnel jitter swamped the differential: a spike in the short
-        # chain's best makes the long chain look free.  Report the failed
-        # measurement as such, never a fictional rate.
+
+    # Median-of-k with recorded spread (round-3 verdict: single-shot
+    # differentials carried unquantified noise).  Repeat until the
+    # samples agree within 20% or the attempt budget runs out.
+    samples = []
+    for _ in range(5):
+        per = (timed(runs[2 * n]) - timed(runs[n])) / n
+        if per > 0:
+            samples.append(per)
+        if len(samples) >= 3:
+            lo_s, hi_s = min(samples), max(samples)
+            if (hi_s - lo_s) / hi_s < 0.20:
+                break
+    if len(samples) < 3:
+        # Tunnel jitter swamped the differentials (non-positive samples):
+        # a spike in the short chain's best makes the long chain look
+        # free.  Fewer than 3 clean samples is not a measurement — report
+        # it as such, never a fictional rate.
         return {
             "rung": "kernel_1m",
             "decisions_per_sec": 0,
@@ -141,12 +156,16 @@ def rung_kernel():
             "unreliable": True,
             "vs_target_50m": 0,
         }
+    per_tick = float(np.median(samples))
+    spread = (max(samples) - min(samples)) / max(samples)
     rate = batch / per_tick
     return {
         "rung": "kernel_1m",
         "decisions_per_sec": round(rate, 1),
         "tick_ms": round(per_tick * 1000, 4),
         "batch": batch,
+        "samples": len(samples),
+        "spread": round(spread, 3),
         "vs_target_50m": round(rate / TARGET_DECISIONS, 4),
     }
 

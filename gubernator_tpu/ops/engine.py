@@ -1497,6 +1497,12 @@ class TickEngine:
         self._tick = _jitted_tick(self.capacity, self.layout,
                                   sorted_input=True, compact_resp=True,
                                   compact_req=True)
+        # Unique-slot batches (no duplicate keys after the host sort) run
+        # the parts-native program: pure int32/f32, no XLA 64-bit
+        # emulation, Pallas-fusable (ops/tick32.py).
+        from gubernator_tpu.ops.tick32 import jitted_tick32
+
+        self._tick32 = jitted_tick32(self.capacity, self.layout)
         # Tick widths: one narrow program for typical service batches
         # (≤ the reference's 1000-item batch limit) plus the full width.
         # Singleton for small engines so test clusters don't pay an extra
@@ -1560,6 +1566,10 @@ class TickEngine:
             m = np.zeros((REQ32_ROWS, w), np.int32)
             m[REQ32_INDEX["slot"]] = self.capacity
             self.state, resp = self._tick(
+                self.state, jnp.asarray(m), jnp.int64(0)
+            )
+            np.asarray(resp)
+            self.state, resp = self._tick32(
                 self.state, jnp.asarray(m), jnp.int64(0)
             )
             np.asarray(resp)
@@ -1757,7 +1767,7 @@ class TickEngine:
         if errors:
             sel = np.array([i for i in range(n) if i not in errors], np.int64)
             if len(sel) == 0:
-                return m, n, errors, np.arange(n, dtype=np.int64)
+                return m, n, errors, np.arange(n, dtype=np.int64), False
             slots, known = self.slots.resolve_batch(
                 [cols.key_bytes(int(i)) for i in sel]
             )
@@ -1839,7 +1849,15 @@ class TickEngine:
         m[:, :n] = m[:, :n][:, order]
         inv = np.empty(n, np.int64)
         inv[order] = np.arange(n)
-        return m, n, errors, inv
+        # Sorted neighbors reveal duplicate slots for free; error rows sit
+        # at slot == capacity and don't count.  Unique batches dispatch to
+        # the parts-native program (no 64-bit ops, Mosaic-compilable),
+        # duplicate-bearing ones to the merge-capable program.
+        sl = m[R["slot"], :n]
+        has_dups = bool(
+            ((sl[1:] == sl[:-1]) & (sl[1:] < self.capacity)).any()
+        )
+        return m, n, errors, inv, has_dups
 
     def _read_through(self, requests, sel, slots, known, miss) -> None:
         """Store.Get for cache misses (algorithms.go:45-51): install the
@@ -1898,11 +1916,12 @@ class TickEngine:
             now = now if now is not None else timeutil.now_ms()
             self._last_now = max(self._last_now, now)
             self._tick_count += 1
-            packed, n, errors, inv = self._build_cols(cols, now)
+            packed, n, errors, inv, has_dups = self._build_cols(cols, now)
             # Named range in XProf captures (utils/tracing.py): device
             # tick vs host packing shows up separated in the profile.
             with tracing.profile_annotation("guber.tick"):
-                self.state, resp = self._tick(
+                tick = self._tick if has_dups else self._tick32
+                self.state, resp = tick(
                     self.state, jnp.asarray(packed), jnp.int64(now)
                 )
             self._pending.clear()

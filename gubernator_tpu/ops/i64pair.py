@@ -20,11 +20,14 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 I32 = jnp.int32
 
-_SIGN = jnp.int32(-0x80000000)  # 0x80000000 bit pattern
-_M16 = jnp.int32(0xFFFF)
+# numpy scalars (not jnp): they embed as literals, so kernels built from
+# these ops stay closed (Pallas rejects captured device constants).
+_SIGN = np.int32(-0x80000000)  # 0x80000000 bit pattern
+_M16 = np.int32(0xFFFF)
 
 
 class I64(NamedTuple):

@@ -27,18 +27,20 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from gubernator_tpu.ops import i64pair as p64
 
 F32 = jnp.float32
 I32 = jnp.int32
 
-_P24 = jnp.float32(1 << 24)
-_P32 = jnp.float32(2.0**32)
-_PM32 = jnp.float32(2.0**-32)
-_P48 = jnp.float32(2.0**48)
-_P16 = jnp.float32(1 << 16)
-_SPLIT = jnp.float32((1 << 12) + 1)  # Dekker split constant for f32
+# numpy scalars so kernels using these ops stay closed (see i64pair.py)
+_P24 = np.float32(1 << 24)
+_P32 = np.float32(2.0**32)
+_PM32 = np.float32(2.0**-32)
+_P48 = np.float32(2.0**48)
+_P16 = np.float32(1 << 16)
+_SPLIT = np.float32((1 << 12) + 1)  # Dekker split constant for f32
 
 
 class T3(NamedTuple):

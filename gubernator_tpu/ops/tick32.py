@@ -1,0 +1,107 @@
+"""The parts-native tick program: compact i32 requests in, compact i32
+responses out, no 64-bit ops anywhere in the hot path.
+
+This is the unique-slot fast program: the host sorts every batch by slot
+(engine._build_cols) and knows whether duplicates exist; batches with at
+most one request per slot — the overwhelming production shape and the
+bench worst case — dispatch here, duplicate-bearing batches take the
+merge-capable program (engine.make_tick_fn).  Keeping the two as
+separate host-dispatched programs (instead of a traced lax.cond) lets
+this one stay pure int32/float32, which is what allows it to run inside
+a Mosaic kernel at all (Mosaic refuses jax_enable_x64 programs) and
+removes XLA's emulated-64-bit overhead from the XLA fallback.
+
+Layouts:
+* ``row`` — Pallas per-row DMA gather/scatter around a parts transition
+  (fused kernel lands behind this same factory).
+* ``columns`` — direct i32 part-column gathers/scatters (the 100M-slot
+  regime, where the row table doesn't fit).
+
+Reference semantics: algorithms.go:37-493 via ops/transition32.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops import i64pair as p64
+from gubernator_tpu.ops.transition32 import (
+    preq_from_compact,
+    presp_to_compact,
+    pstate_from_matrix,
+    pstate_gather_columns,
+    pstate_scatter_columns,
+    pstate_to_matrix,
+    transition32,
+)
+
+I32 = jnp.int32
+
+
+def now_to_pair(now: jnp.ndarray) -> p64.I64:
+    """Scalar int64 ``now`` → (lo, hi) i32 pair (scalar arithmetic only —
+    this toolchain's X64 rewriter has no 64-bit bitcasts)."""
+    hi = (now >> 32).astype(I32)
+    lo_u = now & jnp.int64(0xFFFFFFFF)
+    lo = jnp.where(
+        lo_u >= jnp.int64(1 << 31), lo_u - jnp.int64(1 << 32), lo_u
+    ).astype(I32)
+    return p64.I64(lo, hi)
+
+
+def make_tick32_fn(capacity: int, layout: str = "columns",
+                   fused: bool | None = None):
+    """Build (state, m32, now) → (state, resp6) for unique-slot batches.
+
+    Contract (matches make_tick_fn's compact in/out so TickHandle code is
+    shared): ``m32`` is the (19, B) compact request matrix, slot-sorted,
+    padding/error rows carrying slot == capacity; at most one valid
+    request per real slot.  ``resp6`` is the (6, B) compact response
+    matrix; rows past the live count are unspecified.
+    """
+
+    if layout == "row":
+        import os
+
+        if fused is None:
+            fused = os.environ.get("GUBER_TPU_FUSED_TICK", "1") != "0"
+        if fused:
+            from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
+
+            return make_fused_tick_fn(capacity)
+
+        from gubernator_tpu.ops.rowtable import gather_rows, scatter_rows
+
+        def tick(state, m32, now):
+            r = preq_from_compact(m32)
+            slots = jnp.clip(r.slot, 0, capacity)
+            mat = gather_rows(state.table, slots)
+            s = pstate_from_matrix(mat)
+            new_g, resp = transition32(now_to_pair(now), s, r)
+            scat = jnp.where(r.valid, slots, jnp.int32(capacity))
+            table = scatter_rows(state.table, scat, pstate_to_matrix(new_g))
+            return state._replace(table=table), presp_to_compact(resp)
+
+    else:
+
+        def tick(state, m32, now):
+            r = preq_from_compact(m32)
+            slots = jnp.clip(r.slot, 0, capacity - 1)
+            s = pstate_gather_columns(state, slots)
+            new_g, resp = transition32(now_to_pair(now), s, r)
+            # unclipped slot: padding rows (slot == capacity) drop
+            scat = jnp.where(r.valid, r.slot, jnp.int32(capacity))
+            state = pstate_scatter_columns(state, scat, new_g)
+            return state, presp_to_compact(resp)
+
+    return tick
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_tick32(capacity: int, layout: str = "columns",
+                  fused: bool | None = None):
+    return jax.jit(
+        make_tick32_fn(capacity, layout, fused=fused), donate_argnums=(0,))

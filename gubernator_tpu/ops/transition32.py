@@ -1,0 +1,457 @@
+"""Parts-native bucket transition: the full token/leaky decision tree in
+pure int32/float32 ops.
+
+Semantically this is :func:`gubernator_tpu.ops.buckets.bucket_transition`
+(itself the vectorized form of the reference's ``tokenBucket()`` /
+``leakyBucket()``, algorithms.go:37-493, every branch and quirk in the
+same precedence) restated over the *storage* representation — i64 fields
+as (lo, hi) int32 pairs (:mod:`gubernator_tpu.ops.i64pair`), the leaky
+``remaining`` float64 as its Dekker triple-f32 split
+(:mod:`gubernator_tpu.ops.tfloat`).  Running on the parts directly:
+
+* removes ``jax_enable_x64`` from the tick entirely (XLA's generic
+  64-bit emulation and the bitcast-heavy row<->logical conversion were
+  ~30% of a 32K tick), and
+* makes the transition compilable *inside* a Mosaic/Pallas kernel,
+  where it can overlap the per-row DMA streams (the fused tick).
+
+Every function here is shape-polymorphic and elementwise, so the same
+code serves (B,) XLA columns and (1, C) Pallas blocks.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from gubernator_tpu.ops import i64pair as p64
+from gubernator_tpu.ops import tfloat as tf
+from gubernator_tpu.ops.i64pair import I64
+from gubernator_tpu.ops.tfloat import T3
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+I32 = jnp.int32
+F32 = jnp.float32
+
+
+class PState(NamedTuple):
+    """Per-request gathered bucket state, storage parts (cf. BucketState)."""
+
+    algorithm: jnp.ndarray   # i32
+    limit: I64
+    remaining: I64
+    remaining_f: T3
+    duration: I64
+    created_at: I64
+    updated_at: I64
+    burst: I64
+    status: jnp.ndarray      # i32
+    expire_at: I64
+    in_use: jnp.ndarray      # bool
+
+
+class PReq(NamedTuple):
+    """Request batch, storage parts (cf. ReqBatch)."""
+
+    slot: jnp.ndarray        # i32
+    known: jnp.ndarray       # bool
+    hits: I64
+    limit: I64
+    duration: I64
+    algorithm: jnp.ndarray   # i32
+    behavior: jnp.ndarray    # i32
+    created_at: I64
+    burst: I64
+    greg_exp: I64
+    greg_dur: I64
+    valid: jnp.ndarray       # bool
+
+
+class PResp(NamedTuple):
+    """Responses, storage parts (compact wire: limit echoed host-side)."""
+
+    status: jnp.ndarray      # i32
+    remaining: I64
+    reset_time: I64
+    over_limit: jnp.ndarray  # bool
+
+
+def transition32(now: I64, s: PState, r: PReq) -> tuple[PState, PResp]:
+    """Mirror of ``bucket_transition`` on parts — same branch structure,
+    same precedence, same quirks; see buckets.py for the line-by-line
+    reference mapping.  Comments here mark only parts-specific moves."""
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+
+    shape = jnp.shape(r.slot)
+    zero = p64.const(0, r.slot)
+    one = p64.const(1, r.slot)
+    zero_t = tf.zeros_like(r.slot)
+
+    reset_b = (r.behavior & jnp.int32(Behavior.RESET_REMAINING)) != 0
+    drain_b = (r.behavior & jnp.int32(Behavior.DRAIN_OVER_LIMIT)) != 0
+    greg_b = (r.behavior & jnp.int32(Behavior.DURATION_IS_GREGORIAN)) != 0
+
+    exists = r.known & s.in_use & p64.le(now, s.expire_at)
+    is_token = r.algorithm == jnp.int32(Algorithm.TOKEN_BUCKET)
+    algo_match = s.algorithm == r.algorithm
+
+    h = r.hits
+    h_query = p64.is_zero(h)
+    h_pos = p64.gt(h, zero)
+    safe_limit_t = tf.from_pair(p64.select(p64.is_zero(r.limit), one, r.limit))
+
+    # ------------------------------------------------------------------
+    # TOKEN BUCKET
+    # ------------------------------------------------------------------
+    tok_reset = exists & reset_b
+    tok_exist = exists & ~reset_b & algo_match
+
+    t_rem0 = p64.select(
+        p64.ne(s.limit, r.limit),
+        p64.max_(p64.add(s.remaining, p64.sub(r.limit, s.limit)), zero),
+        s.remaining,
+    )
+    rl_status = s.status
+    rl_rem_base = t_rem0
+    dur_changed = p64.ne(s.duration, r.duration)
+    expire_cand = p64.select(
+        greg_b, r.greg_exp, p64.add(s.created_at, r.duration))
+    renew = p64.le(expire_cand, r.created_at)
+    expire_new = p64.select(
+        renew, p64.add(r.created_at, r.duration), expire_cand)
+    t_created = p64.select(dur_changed & renew, r.created_at, s.created_at)
+    t_rem1 = p64.select(dur_changed & renew, r.limit, t_rem0)
+    t_expire = p64.select(dur_changed, expire_new, s.expire_at)
+    rl_reset = p64.select(dur_changed, expire_new, s.expire_at)
+
+    t_query = h_query
+    t_at_zero = ~t_query & p64.is_zero(rl_rem_base) & h_pos
+    t_exact = ~t_query & ~t_at_zero & p64.eq(t_rem1, h)
+    t_over = ~t_query & ~t_at_zero & ~t_exact & p64.gt(h, t_rem1)
+    t_dec = ~t_query & ~t_at_zero & ~t_exact & ~t_over
+
+    te_rem = p64.select(
+        t_exact,
+        zero,
+        p64.select(
+            t_over,
+            p64.select(drain_b, zero, t_rem1),
+            p64.select(t_dec, p64.sub(t_rem1, h), t_rem1),
+        ),
+    )
+    te_status = jnp.where(t_at_zero, OVER, s.status)
+    te_resp_status = jnp.where(t_at_zero | t_over, OVER, rl_status)
+    te_resp_rem = p64.select(
+        t_exact,
+        zero,
+        p64.select(
+            t_over,
+            p64.select(drain_b, zero, rl_rem_base),
+            p64.select(t_dec, p64.sub(t_rem1, h), rl_rem_base),
+        ),
+    )
+
+    tn_expire = p64.select(
+        greg_b, r.greg_exp, p64.add(r.created_at, r.duration))
+    tn_over = p64.gt(h, r.limit)
+    tn_rem = p64.select(tn_over, r.limit, p64.sub(r.limit, h))
+    tn_resp_status = jnp.where(tn_over, OVER, UNDER)
+
+    # ------------------------------------------------------------------
+    # LEAKY BUCKET
+    # ------------------------------------------------------------------
+    burst = p64.select(p64.is_zero(r.burst), r.limit, r.burst)
+    leak_exist = exists & algo_match
+
+    b_rem0 = tf.select(reset_b, tf.from_pair(burst), s.remaining_f)
+    burst_changed = p64.ne(s.burst, burst)
+    b_rem1 = tf.select(
+        burst_changed & p64.gt(burst, tf.floor_to_pair(b_rem0)),
+        tf.from_pair(burst),
+        b_rem0,
+    )
+    rate = tf.div(
+        tf.from_pair(p64.select(greg_b, r.greg_dur, r.duration)),
+        safe_limit_t,
+    )
+    duration_eff = p64.select(greg_b, p64.sub(r.greg_exp, now), r.duration)
+    elapsed = p64.sub(r.created_at, s.updated_at)
+    rate_zero = (rate.hi == 0) & (rate.mid == 0) & (rate.lo == 0)
+    one_t = tf.from_f32(jnp.ones(shape, F32))
+    leak = tf.div(tf.from_pair(elapsed), tf.select(rate_zero, one_t, rate))
+    # int64(leak) > 0  <=>  leak >= 1 (negatives truncate toward zero)
+    leaked = tf.ge(leak, one_t)
+    b_rem2 = tf.select(leaked, tf.add(b_rem1, leak), b_rem1)
+    b_upd = p64.select(leaked, r.created_at, s.updated_at)
+    # int64(b_rem2) > burst  <=>  b_rem2 >= burst + 1 (b_rem2, burst >= 0)
+    b_rem3 = tf.select(
+        tf.ge_pair(b_rem2, p64.add(burst, one)), tf.from_pair(burst), b_rem2)
+
+    rem_i = tf.floor_to_pair(b_rem3)
+    rate_i = tf.floor_to_pair(rate)
+    l_at_zero = p64.is_zero(rem_i) & h_pos
+    l_exact = ~l_at_zero & p64.eq(rem_i, h)
+    l_over = ~l_at_zero & ~l_exact & p64.gt(h, rem_i)
+    l_query = ~l_at_zero & ~l_exact & ~l_over & h_query
+    l_dec = ~l_at_zero & ~l_exact & ~l_over & ~l_query
+
+    le_remf = tf.select(
+        l_exact,
+        zero_t,
+        tf.select(
+            l_over,
+            tf.select(drain_b, zero_t, b_rem3),
+            tf.select(l_dec, tf.sub(b_rem3, tf.from_pair(h)), b_rem3),
+        ),
+    )
+    le_resp_status = jnp.where(l_at_zero | l_over, OVER, UNDER)
+    # trunc(b_rem3 - h) == floor(b_rem3) - h: h integral, result >= 0
+    le_resp_rem = p64.select(
+        l_exact,
+        zero,
+        p64.select(
+            l_over,
+            p64.select(drain_b, zero, rem_i),
+            p64.select(l_dec, p64.sub(rem_i, h), rem_i),
+        ),
+    )
+    le_reset_rem = p64.select(l_over, rem_i, le_resp_rem)
+    le_resp_reset = p64.add(
+        r.created_at, p64.mul(p64.sub(r.limit, le_reset_rem), rate_i))
+    le_expire = p64.select(
+        ~h_query, p64.add(r.created_at, duration_eff), s.expire_at)
+
+    ln_rate_i = tf.floor_to_pair(tf.div(tf.from_pair(r.duration), safe_limit_t))
+    ln_duration = p64.select(greg_b, p64.sub(r.greg_exp, now), r.duration)
+    ln_over = p64.gt(h, burst)
+    ln_remf = tf.select(
+        ln_over, zero_t, tf.from_pair(p64.sub(burst, h)))
+    ln_resp_rem = p64.select(ln_over, zero, p64.sub(burst, h))
+    ln_resp_reset = p64.add(
+        r.created_at, p64.mul(p64.sub(r.limit, ln_resp_rem), ln_rate_i))
+    ln_resp_status = jnp.where(ln_over, OVER, UNDER)
+    ln_expire = p64.add(r.created_at, ln_duration)
+
+    # ------------------------------------------------------------------
+    # Select per-request outcome (token-reset / token-exist / token-new /
+    # leaky-exist / leaky-new)
+    # ------------------------------------------------------------------
+    def sel32(tr, te, tn, le, ln):
+        tok = jnp.where(tok_reset, tr, jnp.where(tok_exist, te, tn))
+        lk = jnp.where(leak_exist, le, ln)
+        return jnp.where(is_token, tok, lk)
+
+    def sel64(tr, te, tn, le, ln):
+        tok = p64.select(tok_reset, tr, p64.select(tok_exist, te, tn))
+        lk = p64.select(leak_exist, le, ln)
+        return p64.select(is_token, tok, lk)
+
+    def selt(tr, te, tn, le, ln):
+        tok = tf.select(tok_reset, tr, tf.select(tok_exist, te, tn))
+        lk = tf.select(leak_exist, le, ln)
+        return tf.select(is_token, tok, lk)
+
+    # 0/1 int32 lanes, not bool: Mosaic cannot lower selects between
+    # bool vectors (i8->i1 truncation); the != 0 at the end emits a
+    # plain compare instead.
+    true_ = jnp.ones(shape, I32)
+    false_ = jnp.zeros(shape, I32)
+
+    new_state = PState(
+        algorithm=jnp.where(
+            is_token,
+            jnp.int32(Algorithm.TOKEN_BUCKET),
+            jnp.int32(Algorithm.LEAKY_BUCKET),
+        ),
+        limit=r.limit,
+        remaining=sel64(zero, te_rem, tn_rem, s.remaining, s.remaining),
+        remaining_f=selt(
+            zero_t, s.remaining_f, s.remaining_f, le_remf, ln_remf),
+        duration=sel64(zero, r.duration, r.duration, r.duration, ln_duration),
+        created_at=sel64(
+            zero, t_created, r.created_at, s.created_at, s.created_at),
+        updated_at=sel64(
+            zero, s.updated_at, s.updated_at, b_upd, r.created_at),
+        burst=sel64(zero, s.burst, s.burst, burst, burst),
+        status=sel32(
+            jnp.zeros(shape, I32), te_status, UNDER, s.status, UNDER),
+        expire_at=sel64(zero, t_expire, tn_expire, le_expire, ln_expire),
+        in_use=sel32(false_, true_, true_, true_, true_) != 0,
+    )
+
+    resp = PResp(
+        status=sel32(
+            jnp.full(shape, UNDER), te_resp_status, tn_resp_status,
+            le_resp_status, ln_resp_status),
+        remaining=sel64(r.limit, te_resp_rem, tn_rem, le_resp_rem,
+                        ln_resp_rem),
+        reset_time=sel64(zero, rl_reset, tn_expire, le_resp_reset,
+                         ln_resp_reset),
+        over_limit=sel32(
+            false_,
+            (t_at_zero | t_over).astype(I32),
+            tn_over.astype(I32),
+            (l_at_zero | l_over).astype(I32),
+            ln_over.astype(I32),
+        ) != 0,
+    )
+    return new_state, resp
+
+
+# ----------------------------------------------------------------------
+# Wire / table adapters
+# ----------------------------------------------------------------------
+def preq_from_compact(m32: jnp.ndarray) -> PReq:
+    """(19, B) compact int32 request matrix → PReq (no 64-bit ops;
+    device-side inverse of pack_request_matrix32)."""
+    from gubernator_tpu.ops.engine import REQ32_INDEX
+
+    def wide(name):
+        i = REQ32_INDEX[name]
+        return I64(m32[i], m32[i + 1])
+
+    return PReq(
+        slot=m32[REQ32_INDEX["slot"]],
+        known=m32[REQ32_INDEX["known"]] != 0,
+        hits=wide("hits"),
+        limit=wide("limit"),
+        duration=wide("duration"),
+        algorithm=m32[REQ32_INDEX["algorithm"]],
+        behavior=m32[REQ32_INDEX["behavior"]],
+        created_at=wide("created_at"),
+        burst=wide("burst"),
+        greg_exp=wide("greg_exp"),
+        greg_dur=wide("greg_dur"),
+        valid=m32[REQ32_INDEX["valid"]] != 0,
+    )
+
+
+def presp_to_compact(resp: PResp) -> jnp.ndarray:
+    """PResp → (6, B) compact int32 response matrix (same row order as
+    pack_resp_compact: status, over, rem lo/hi, reset lo/hi)."""
+    return jnp.stack([
+        resp.status,
+        resp.over_limit.astype(I32),
+        resp.remaining.lo,
+        resp.remaining.hi,
+        resp.reset_time.lo,
+        resp.reset_time.hi,
+    ])
+
+
+def _f32(x):
+    return lax.bitcast_convert_type(x, F32)
+
+
+def _i32(x):
+    return lax.bitcast_convert_type(x, I32)
+
+
+def pstate_from_matrix(m: jnp.ndarray) -> PState:
+    """(B, ROW_W) gathered row matrix → PState (int32 slices + f32
+    bitcasts only — replaces matrix_to_logical's x64 conversion)."""
+    from gubernator_tpu.ops.rowtable import FIELD_OFFSETS as O
+
+    def pair(f):
+        return I64(m[..., O[f]], m[..., O[f] + 1])
+
+    fo = O["remaining_f"]
+    return PState(
+        algorithm=m[..., O["algorithm"]],
+        limit=pair("limit"),
+        remaining=pair("remaining"),
+        remaining_f=T3(
+            _f32(m[..., fo]), _f32(m[..., fo + 1]), _f32(m[..., fo + 2])),
+        duration=pair("duration"),
+        created_at=pair("created_at"),
+        updated_at=pair("updated_at"),
+        burst=pair("burst"),
+        status=m[..., O["status"]],
+        expire_at=pair("expire_at"),
+        in_use=m[..., O["in_use"]] != 0,
+    )
+
+
+def pstate_to_matrix(s: PState) -> jnp.ndarray:
+    """PState → (B, ROW_W) row matrix (inverse of pstate_from_matrix;
+    spare words zero, like logical_to_matrix)."""
+    from gubernator_tpu.ops.rowtable import ROW_W
+
+    cols = [
+        s.algorithm,
+        s.limit.lo, s.limit.hi,
+        s.remaining.lo, s.remaining.hi,
+        _i32(s.remaining_f.hi), _i32(s.remaining_f.mid),
+        _i32(s.remaining_f.lo),
+        s.duration.lo, s.duration.hi,
+        s.created_at.lo, s.created_at.hi,
+        s.updated_at.lo, s.updated_at.hi,
+        s.burst.lo, s.burst.hi,
+        s.status,
+        s.expire_at.lo, s.expire_at.hi,
+        s.in_use.astype(I32),
+    ]
+    mat = jnp.stack(cols, axis=-1)
+    b = mat.shape[:-1]
+    return jnp.concatenate(
+        [mat, jnp.zeros(b + (ROW_W - len(cols),), I32)], axis=-1)
+
+
+def pstate_gather_columns(state, idx: jnp.ndarray) -> PState:
+    """Gather a PState from a stored-layout column-table BucketState
+    (tuples of i32 part columns) without any 64-bit conversion."""
+
+    def pair(f):
+        lo, hi = getattr(state, f)
+        return I64(lo[idx], hi[idx])
+
+    fh, fm, fl = state.remaining_f
+    return PState(
+        algorithm=state.algorithm[idx],
+        limit=pair("limit"),
+        remaining=pair("remaining"),
+        remaining_f=T3(_f32(fh[idx]), _f32(fm[idx]), _f32(fl[idx])),
+        duration=pair("duration"),
+        created_at=pair("created_at"),
+        updated_at=pair("updated_at"),
+        burst=pair("burst"),
+        status=state.status[idx],
+        expire_at=pair("expire_at"),
+        in_use=state.in_use[idx],
+    )
+
+
+def pstate_scatter_columns(state, idx: jnp.ndarray, rows: PState):
+    """Scatter a PState back into a stored-layout column BucketState
+    (drop mode, like scatter_state)."""
+
+    def put(col, vals):
+        return col.at[idx].set(vals, mode="drop")
+
+    return state._replace(
+        algorithm=put(state.algorithm, rows.algorithm),
+        limit=(put(state.limit[0], rows.limit.lo),
+               put(state.limit[1], rows.limit.hi)),
+        remaining=(put(state.remaining[0], rows.remaining.lo),
+                   put(state.remaining[1], rows.remaining.hi)),
+        remaining_f=(
+            put(state.remaining_f[0], _i32(rows.remaining_f.hi)),
+            put(state.remaining_f[1], _i32(rows.remaining_f.mid)),
+            put(state.remaining_f[2], _i32(rows.remaining_f.lo)),
+        ),
+        duration=(put(state.duration[0], rows.duration.lo),
+                  put(state.duration[1], rows.duration.hi)),
+        created_at=(put(state.created_at[0], rows.created_at.lo),
+                    put(state.created_at[1], rows.created_at.hi)),
+        updated_at=(put(state.updated_at[0], rows.updated_at.lo),
+                    put(state.updated_at[1], rows.updated_at.hi)),
+        burst=(put(state.burst[0], rows.burst.lo),
+               put(state.burst[1], rows.burst.hi)),
+        status=put(state.status, rows.status),
+        expire_at=(put(state.expire_at[0], rows.expire_at.lo),
+                   put(state.expire_at[1], rows.expire_at.hi)),
+        in_use=put(state.in_use, rows.in_use),
+    )
