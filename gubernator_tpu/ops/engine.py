@@ -522,19 +522,25 @@ def _apply_merged_followers(
     # transition output (identical to a table gather after the head
     # scatter, minus the gather).
     return _merged_formulas(
-        new_g, resp, reqs, now, rank, group_size, ok, group_ok,
-        hd(new_g.remaining), hd(new_g.remaining_f),
-        hd(new_g.status), hd(new_g.expire_at),
+        new_g, resp, reqs, now, rank, group_size - 1,
+        fold_mask=group_ok & ok & (rank > 0),
+        head_mask=group_ok & ok & (rank == 0) & (group_size > 1),
+        R0=hd(new_g.remaining), F0=hd(new_g.remaining_f),
+        S0=hd(new_g.status), E=hd(new_g.expire_at),
     )
 
 
-def _merged_formulas(new_g, resp, reqs, now, rank, group_size, ok, group_ok,
-                     R0, F0, S0, E):
+def _merged_formulas(new_g, resp, reqs, now, rank, last_rank, fold_mask,
+                     head_mask, R0, F0, S0, E):
     """The closed-form follower fold shared by the gather-based (unsorted)
-    and scan-based (sorted-input) merge paths; see
+    group merge and the scan-based (sorted-input) unit merge; see
     :func:`_apply_merged_followers` for the math.  ``R0/F0/S0/E`` are the
-    group head's post-transition remaining/remaining_f/status/expire_at
-    broadcast to every member."""
+    fold head's post-transition remaining/remaining_f/status/expire_at
+    broadcast to every member; ``rank`` is the member's distance from
+    that head, ``last_rank`` the distance of the fold window's last
+    member.  ``fold_mask``/``head_mask`` select the members folding /
+    the heads absorbing a window (both are further gated on the head
+    state being alive here)."""
     TOKEN = jnp.int32(Algorithm.TOKEN_BUCKET)
     UNDER = jnp.int32(Status.UNDER_LIMIT)
     OVER = jnp.int32(Status.OVER_LIMIT)
@@ -542,7 +548,7 @@ def _merged_formulas(new_g, resp, reqs, now, rank, group_size, ok, group_ok,
     N0 = F0.astype(jnp.int64)  # Go float64→int64 truncation
     alive = now <= E
 
-    merged = group_ok & ok & alive & (rank > 0)
+    merged = fold_mask & alive
 
     h = jnp.where(reqs.hits > 0, reqs.hits, jnp.int64(1))  # div-safe
     i = rank.astype(jnp.int64)
@@ -575,9 +581,9 @@ def _merged_formulas(new_g, resp, reqs, now, rank, group_size, ok, group_ok,
         over_limit=jnp.where(merged, ~under, resp.over_limit),
     )
 
-    # Group-final state, evaluated at the LAST member's rank and folded
-    # into the head's scatter row (one scatter for head + whole group).
-    li = (group_size - 1).astype(jnp.int64)
+    # Window-final state, evaluated at the LAST member's rank and folded
+    # into the head's scatter row (one scatter for head + whole window).
+    li = last_rank.astype(jnp.int64)
     l_under = li <= q
     rem_last = jnp.where(l_under, base - li * h, rem_over)
     divisible = base - q * h == 0
@@ -593,7 +599,7 @@ def _merged_formulas(new_g, resp, reqs, now, rank, group_size, ok, group_ok,
         jnp.float64(0.0),
         F0 - (jnp.minimum(li, q) * h).astype(jnp.float64),
     )
-    head_ovr = group_ok & ok & alive & (rank == 0) & (group_size > 1)
+    head_ovr = head_mask & alive
     rows = new_g._replace(
         remaining=jnp.where(head_ovr & is_tok, rem_last, new_g.remaining),
         status=jnp.where(head_ovr & is_tok, status_last, new_g.status),
@@ -618,42 +624,39 @@ def _seg_propagate(is_start, vals):
     return out[1:]
 
 
-def _seg_any(is_start, bad):
-    """Per-row "any bad member in my segment" without scatters: a forward
-    segmented OR covers [start..i], a backward one covers [i..end]."""
+def _seg_min_all(is_start, val):
+    """Per-row minimum of ``val`` over the row's whole segment, without
+    scatters: a forward segmented min covers [start..i], a backward one
+    covers [i..end]."""
     def combine(a, b):
         fa, va = a
         fb, vb = b
-        return fa | fb, jnp.where(fb, vb, va | vb)
+        return fa | fb, jnp.where(fb, vb, jnp.minimum(va, vb))
 
-    fwd = lax.associative_scan(combine, (is_start, bad))[1]
+    fwd = lax.associative_scan(combine, (is_start, val))[1]
     last = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
     bwd = lax.associative_scan(
-        combine, (last[::-1], bad[::-1])
+        combine, (last[::-1], val[::-1])
     )[1][::-1]
-    return fwd | bwd
+    return jnp.minimum(fwd, bwd)
 
 
-def _apply_merged_followers_sorted(
-    new_g: BucketState,
-    resp: RespBatch,
-    reqs: ReqBatch,
-    now: jnp.ndarray,
-    rank: jnp.ndarray,
-    group_size: jnp.ndarray,
-    is_start: jnp.ndarray,
-):
-    """Scan-based merge fold for slot-sorted batches.
+def _sorted_merge_plan(reqs: ReqBatch, is_start: jnp.ndarray):
+    """Static fold structure for a slot-sorted batch: the ``ok``
+    fold-eligibility predicate and the end index of each row's *unit*
+    (maximal contiguous run of identical fold-eligible requests).
 
-    Same semantics as :func:`_apply_merged_followers`, but with the batch
-    sorted by slot every segment is a contiguous run, so the head-value
-    broadcasts become one segmented scan and the group-wide "every member
-    mergeable" check becomes neighbor comparisons + segmented ORs — no
-    B-sized gathers or scatters at all (8-byte gathers/scatters measured
-    ~0.5/3.4 ms per 32k op on v5e; scans are tens of µs)."""
+    Units are the granularity of the sorted tick's rounds: a uniform
+    duplicate group is one unit (one round — the thundering-herd fast
+    path), and a group broken by RESET/Gregorian/query/parameter-change
+    rows costs one round per unit, NOT one per duplicate (round-3's 6.5 s
+    adversarial corner: a ~700-deep hot key interleaved with RESET rows
+    degenerated to ~700 gather+scatter rounds)."""
     NO_MERGE = jnp.int32(
         Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN
     )
+    b = reqs.slot.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
 
     def eq_prev(a):
         return jnp.concatenate(
@@ -661,8 +664,7 @@ def _apply_merged_followers_sorted(
         )
 
     # "Equals its predecessor" chains to "equals its head" within a
-    # contiguous segment, so the group-wide ALL over this row predicate is
-    # exactly the unsorted path's same_as_head quantifier.
+    # contiguous run, so run membership is a neighbor compare.
     same_as_prev = is_start | (
         eq_prev(reqs.hits)
         & eq_prev(reqs.limit)
@@ -677,18 +679,15 @@ def _apply_merged_followers_sorted(
         & same_as_prev
         & (reqs.hits > 0)
         & ((reqs.behavior & NO_MERGE) == 0)
-        & (reqs.known | (rank == 0))
+        # group heads are exempt from the known check (their transition
+        # handles the new-item case); group-rank==0 IS is_start
+        & (reqs.known | is_start)
     )
-    group_ok = ~_seg_any(is_start, reqs.valid & ~ok)
-
-    R0, F0, S0, E = _seg_propagate(
-        is_start,
-        (new_g.remaining, new_g.remaining_f, new_g.status, new_g.expire_at),
-    )
-    return _merged_formulas(
-        new_g, resp, reqs, now, rank, group_size, ok, group_ok,
-        R0, F0, S0, E,
-    )
+    unit_start = is_start | ~ok
+    nxt = jnp.where(unit_start, idx, jnp.int32(b))
+    sfx = lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
+    unit_end = jnp.concatenate([sfx[1:], jnp.full((1,), b, jnp.int32)])
+    return ok, unit_end
 
 
 def make_tick_fn(capacity: int, merge_uniform: bool = True,
@@ -716,6 +715,91 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
 
     _, _gather, _scatter = _layout_ops(layout)
 
+    def tick_sorted(state, reqs: ReqBatch, now: jnp.ndarray, resp0):
+        """Sorted-input tick: unit rounds.
+
+        Contract: the host packed the batch sorted by slot with
+        invalid/padding rows (slot=capacity) at the end, so every slot
+        group is a contiguous run and all segment math is neighbor
+        compares + scans — no device sort, no B-sized gathers/scatters
+        anywhere in the merge path.
+
+        Each round applies, per slot, the FIRST not-yet-applied request
+        as that slot's head (full transition) and closed-form-folds the
+        rest of the head's *unit* — its maximal run of identical
+        fold-eligible duplicates (:func:`_sorted_merge_plan`) — so a
+        uniform duplicate group costs one round (the thundering-herd
+        fast path) and a group interleaved with RESET/query/Gregorian or
+        parameter-change rows costs one round per unit, never one per
+        duplicate.  Heads whose post-state is already expired fold
+        nothing; their followers simply head later rounds, preserving
+        exact per-slot sequencing (reference workers.go:19-37 serializes
+        per key; algorithms.go is the per-request bar)."""
+        b = reqs.slot.shape[0]
+        sorted_key = jnp.where(
+            reqs.valid, reqs.slot, capacity
+        ).astype(jnp.int32)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+        )
+        has_dups = jnp.any((~is_start[1:]) & reqs.valid[1:])
+
+        def unique_branch(_):
+            gathered = _gather(state, reqs.slot)
+            new_g, r_out = bucket_transition(now, gathered, reqs)
+            resp = jax.tree.map(
+                lambda old, new: jnp.where(reqs.valid, new, old),
+                resp0, r_out,
+            )
+            scat = jnp.where(reqs.valid, reqs.slot, capacity)
+            return _scatter(state, scat, new_g), resp
+
+        def dup_branch(_):
+            idx = jnp.arange(b, dtype=jnp.int32)
+            ok, unit_end = _sorted_merge_plan(reqs, is_start)
+
+            def cond(carry):
+                return ~jnp.all(carry[0])
+
+            def body(carry):
+                applied, st, resp = carry
+                cand = ~applied
+                headpos = _seg_min_all(
+                    is_start, jnp.where(cand, idx, jnp.int32(b))
+                )
+                head = cand & (idx == headpos)
+                gathered = _gather(st, reqs.slot)
+                new_g, r_out = bucket_transition(now, gathered, reqs)
+                resp = jax.tree.map(
+                    lambda old, new: jnp.where(head, new, old), resp, r_out
+                )
+                # Broadcast the head's post-transition values (and its
+                # position / unit end) forward over its group; rows
+                # before the head are already applied and masked out.
+                R0, F0, S0, E, hpos, uend = _seg_propagate(
+                    is_start | head,
+                    (new_g.remaining, new_g.remaining_f, new_g.status,
+                     new_g.expire_at, idx, unit_end),
+                )
+                fold_rank = idx - hpos
+                fold = cand & ok & (fold_rank > 0) & (idx < uend)
+                rows, resp, merged = _merged_formulas(
+                    new_g, resp, reqs, now, fold_rank, uend - 1 - hpos,
+                    fold_mask=fold,
+                    head_mask=head & (uend - hpos > 1),
+                    R0=R0, F0=F0, S0=S0, E=E,
+                )
+                scat = jnp.where(head, reqs.slot, capacity)
+                st = _scatter(st, scat, rows)
+                return applied | head | merged, st, resp
+
+            _, st, resp = lax.while_loop(
+                cond, body, (~reqs.valid, state, resp0)
+            )
+            return st, resp
+
+        return lax.cond(has_dups, dup_branch, unique_branch, None)
+
     def tick(state, reqs: ReqBatch, now: jnp.ndarray):
         b = reqs.slot.shape[0]
 
@@ -726,6 +810,9 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
             reset_time=jnp.zeros(b, jnp.int64),
             over_limit=jnp.zeros(b, jnp.bool_),
         )
+
+        if merge_uniform and sorted_input:
+            return tick_sorted(state, reqs, now, resp0)
 
         def round_step(st, resp, active):
             gathered = _gather(st, reqs.slot)
@@ -758,70 +845,30 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
                 )
                 return new_g, resp, reqs.valid, jnp.zeros(b, jnp.int32)
 
-            if sorted_input:
-                # Contract: the host packed the batch sorted by slot with
-                # invalid/padding rows (slot=capacity) at the end, so
-                # every slot group is a contiguous run and all segment
-                # math is neighbor compares + scans — no device sort, no
-                # B-sized gathers/scatters anywhere in the merge path.
-                sorted_key = jnp.where(
-                    reqs.valid, reqs.slot, capacity
-                ).astype(jnp.int32)
-                is_start = jnp.concatenate(
-                    [jnp.ones((1,), jnp.bool_),
-                     sorted_key[1:] != sorted_key[:-1]]
+            sort_key = jnp.where(
+                reqs.valid, reqs.slot, capacity
+            ).astype(jnp.int32)
+            order = jnp.argsort(sort_key, stable=True)
+            sorted_key = sort_key[order]
+            has_dups = jnp.any(
+                (sorted_key[1:] == sorted_key[:-1])
+                & (sorted_key[1:] < jnp.int32(capacity))
+            )
+
+            def dup_branch(_):
+                rank, group_size, head_idx, seg_id = (
+                    _segments_from_sorted(sorted_key, order)
                 )
-                has_dups = jnp.any((~is_start[1:]) & reqs.valid[1:])
-
-                def dup_branch(_):
-                    idx = jnp.arange(b, dtype=jnp.int32)
-                    seg_start = lax.associative_scan(
-                        jnp.maximum, jnp.where(is_start, idx, 0)
-                    )
-                    rank = idx - seg_start
-                    nxt = jnp.where(is_start, idx, jnp.int32(b))
-                    sfx = lax.associative_scan(
-                        jnp.minimum, nxt[::-1]
-                    )[::-1]
-                    seg_end = jnp.concatenate(
-                        [sfx[1:], jnp.full((1,), b, jnp.int32)]
-                    )
-                    group_size = seg_end - seg_start
-                    heads = reqs.valid & (rank == 0)
-                    resp = jax.tree.map(
-                        lambda old, new: jnp.where(heads, new, old),
-                        resp0, r_out,
-                    )
-                    rows, resp, merged = _apply_merged_followers_sorted(
-                        new_g, resp, reqs, now, rank, group_size, is_start
-                    )
-                    return rows, resp, merged, rank
-
-            else:
-                sort_key = jnp.where(
-                    reqs.valid, reqs.slot, capacity
-                ).astype(jnp.int32)
-                order = jnp.argsort(sort_key, stable=True)
-                sorted_key = sort_key[order]
-                has_dups = jnp.any(
-                    (sorted_key[1:] == sorted_key[:-1])
-                    & (sorted_key[1:] < jnp.int32(capacity))
+                heads = reqs.valid & (rank == 0)
+                resp = jax.tree.map(
+                    lambda old, new: jnp.where(heads, new, old),
+                    resp0, r_out,
                 )
-
-                def dup_branch(_):
-                    rank, group_size, head_idx, seg_id = (
-                        _segments_from_sorted(sorted_key, order)
-                    )
-                    heads = reqs.valid & (rank == 0)
-                    resp = jax.tree.map(
-                        lambda old, new: jnp.where(heads, new, old),
-                        resp0, r_out,
-                    )
-                    rows, resp, merged = _apply_merged_followers(
-                        new_g, resp, reqs, now,
-                        rank, group_size, head_idx, seg_id,
-                    )
-                    return rows, resp, merged, rank
+                rows, resp, merged = _apply_merged_followers(
+                    new_g, resp, reqs, now,
+                    rank, group_size, head_idx, seg_id,
+                )
+                return rows, resp, merged, rank
 
             rows, resp, merged, rank = lax.cond(
                 has_dups, dup_branch, unique_branch, None

@@ -1,0 +1,150 @@
+"""Unit-level merge correctness: the sorted tick's unit rounds must be
+indistinguishable from pure per-duplicate rank rounds (merge_uniform=
+False ground truth) on adversarial duplicate mixtures — RESET rows
+inside hot groups, parameter flips, queries, negative hits, unknown
+rows — and must do it in rounds proportional to UNITS, not duplicates
+(the round-3 6.5 s head-of-line corner).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.buckets import BucketState
+from gubernator_tpu.ops.engine import (
+    REQ32_INDEX, REQ32_ROWS, make_tick_fn, pack_request_matrix32)
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
+
+NOW = 1_700_000_000_000
+CAP = 256
+
+
+def mk_batch(rng, b, n, hot_frac=0.7, reset_frac=0.1, flip_frac=0.1):
+    """Sorted batch with a deep hot group and adversarial interleaves."""
+    m = np.zeros((REQ32_ROWS, b), np.int32)
+    m[REQ32_INDEX["slot"]] = CAP
+    n_hot = int(n * hot_frac)
+    slots = np.sort(np.concatenate([
+        np.zeros(n_hot, np.int64) + 7,
+        rng.choice([s for s in range(CAP) if s != 7], n - n_hot,
+                   replace=True),
+    ]))
+    reqs = []
+    for i in range(n):
+        p = rng.random()
+        behavior = Behavior(0)
+        hits = 1
+        limit, duration, burst = 50, 30_000, 0
+        if p < reset_frac:
+            behavior = Behavior.RESET_REMAINING
+        elif p < reset_frac + flip_frac:
+            # parameter flips break runs without RESET semantics
+            hits = int(rng.choice([0, 2, 5, -2]))
+            limit = int(rng.choice([50, 51]))
+        reqs.append(RateLimitRequest(
+            name="u", unique_key=f"k{slots[i]}", hits=hits, limit=limit,
+            duration=duration, algorithm=Algorithm(int(rng.integers(0, 2))),
+            behavior=behavior, burst=burst, created_at=NOW,
+        ))
+    known = rng.random(n) < 0.9
+    pack_request_matrix32(m, np.arange(n), reqs, slots, known, NOW)
+    return m
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23, 24, 25])
+def test_unit_rounds_match_rank_rounds(seed):
+    rng = np.random.default_rng(seed)
+    b = 256
+    merged_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True,
+        compact_resp=True, compact_req=True))
+    plain_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True, merge_uniform=False,
+        compact_resp=True, compact_req=True))
+
+    sm = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    sp = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    now = NOW
+    for step in range(6):
+        m = mk_batch(rng, b, int(rng.integers(16, b)))
+        sm, rm = merged_tick(sm, jnp.asarray(m), jnp.int64(now))
+        sp, rp = plain_tick(sp, jnp.asarray(m), jnp.int64(now))
+        np.testing.assert_array_equal(
+            np.asarray(rm), np.asarray(rp), err_msg=f"seed {seed} step {step}")
+        for f in sm._fields:
+            ma, pa = getattr(sm, f), getattr(sp, f)
+            ma = ma if isinstance(ma, tuple) else (ma,)
+            pa = pa if isinstance(pa, tuple) else (pa,)
+            for x, y in zip(ma, pa):
+                np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y),
+                    err_msg=f"seed {seed} step {step} field {f}")
+        now += int(rng.choice([0, 500, 3_000, 61_000]))
+
+
+def test_reset_interleaved_hot_group_unit_count():
+    """A ~180-deep hot key split by a handful of RESET rows must fold in
+    unit-rounds (one per run), not per-duplicate rounds — the semantic
+    outcome must still match rank rounds exactly."""
+    b = 256
+    n = 200
+    m = np.zeros((REQ32_ROWS, b), np.int32)
+    m[REQ32_INDEX["slot"]] = CAP
+    reqs = []
+    slots = np.zeros(n, np.int64) + 3
+    for i in range(n):
+        behavior = (Behavior.RESET_REMAINING
+                    if i in (40, 90, 140) else Behavior(0))
+        reqs.append(RateLimitRequest(
+            name="u", unique_key="hot", hits=1, limit=500,
+            duration=60_000, algorithm=Algorithm.TOKEN_BUCKET,
+            behavior=behavior, created_at=NOW,
+        ))
+    pack_request_matrix32(
+        m, np.arange(n), reqs, slots, np.ones(n, bool), NOW)
+
+    merged_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True,
+        compact_resp=True, compact_req=True))
+    plain_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True, merge_uniform=False,
+        compact_resp=True, compact_req=True))
+    sm = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    sp = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    sm, rm = merged_tick(sm, jnp.asarray(m), jnp.int64(NOW))
+    sp, rp = plain_tick(sp, jnp.asarray(m), jnp.int64(NOW))
+    np.testing.assert_array_equal(np.asarray(rm), np.asarray(rp))
+    # spot-check semantics: first RESET row reports a full bucket and the
+    # run after it restarts the countdown
+    resp = np.asarray(rm)
+    assert resp[2, 40] == 500          # RESET row reports a full bucket
+    assert resp[2, 41] == 499          # new item after removal: 500 - 1
+
+def test_expired_head_falls_back_per_row():
+    """A fold head whose post-state is instantly expired (created_at far
+    in the past) must not fold followers; per-slot sequencing holds."""
+    b = 64
+    n = 8
+    m = np.zeros((REQ32_ROWS, b), np.int32)
+    m[REQ32_INDEX["slot"]] = CAP
+    old = NOW - 10_000_000
+    reqs = [RateLimitRequest(
+        name="u", unique_key="k", hits=1, limit=10, duration=1_000,
+        algorithm=Algorithm.TOKEN_BUCKET, created_at=old)
+        for _ in range(n)]
+    pack_request_matrix32(
+        m, np.arange(n), reqs, np.zeros(n, np.int64),
+        np.ones(n, bool), NOW)
+    merged_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True,
+        compact_resp=True, compact_req=True))
+    plain_tick = jax.jit(make_tick_fn(
+        CAP, layout="columns", sorted_input=True, merge_uniform=False,
+        compact_resp=True, compact_req=True))
+    sm = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    sp = jax.tree.map(jnp.asarray, BucketState.zeros(CAP))
+    sm, rm = merged_tick(sm, jnp.asarray(m), jnp.int64(NOW))
+    sp, rp = plain_tick(sp, jnp.asarray(m), jnp.int64(NOW))
+    np.testing.assert_array_equal(np.asarray(rm), np.asarray(rp))
