@@ -276,19 +276,31 @@ def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=40
 
     # Throughput: pipelined — dispatch runs ahead, responses resolved 16
     # ticks at a time in one D2H transfer each (engine.resolve_ticks).
+    # Timed in 3 segments so the record carries the tunnel's run-to-run
+    # spread (round-3 verdict: single-shot transport rungs can't gate a
+    # 200% threshold under 300% link noise); the rate is the median
+    # segment's.
     from gubernator_tpu.ops.engine import resolve_ticks
 
+    seg_rates = []
     done = 0
-    pending = []
+    tick_i = 0
     t0 = time.perf_counter()
-    for i in range(ticks):
-        c = batches[i % n_batches]
-        pending.append(engine.submit_columns(c, now + i))
-        done += len(c)
-        if len(pending) >= 16:
-            resolve_ticks(pending)
-            pending.clear()
-    resolve_ticks(pending)
+    for seg_ticks in (ticks // 3, ticks // 3, ticks - 2 * (ticks // 3)):
+        s0 = time.perf_counter()
+        seg_done = 0
+        pending = []
+        for _ in range(seg_ticks):
+            c = batches[tick_i % n_batches]
+            pending.append(engine.submit_columns(c, now + tick_i))
+            seg_done += len(c)
+            tick_i += 1
+            if len(pending) >= 16:
+                resolve_ticks(pending)
+                pending.clear()
+        resolve_ticks(pending)
+        seg_rates.append(seg_done / max(time.perf_counter() - s0, 1e-9))
+        done += seg_done
     dt = time.perf_counter() - t0
 
     # Latency: serial, each tick awaited (includes one D2H roundtrip).
@@ -300,11 +312,14 @@ def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=40
         engine.process_columns(c, now=now + ticks + i)
         lat.append((time.perf_counter() - t1) * 1e3)
     p50, p99 = _pcts(lat)
+    seg = sorted(seg_rates)
     out = {
         "rung": label,
         "keys": n_keys,
         "fill_s": round(fill_s, 1),
-        "decisions_per_sec": round(done / dt, 1),
+        "decisions_per_sec": round(seg[len(seg) // 2], 1),
+        "decisions_per_sec_overall": round(done / dt, 1),
+        "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
         "batch": batch,
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
@@ -328,20 +343,27 @@ def rung_herd(unique_dps, algo, label):
     engine = TickEngine(capacity=1 << 14, max_batch=batch)
     cols = _cols(np.zeros(batch, np.int64), 10**12, 3_600_000, algo)
     engine.process_columns(cols, now=now)  # install the key
-    ticks = 50
-    pending = []
-    t0 = time.perf_counter()
-    for i in range(ticks):
-        pending.append(engine.submit_columns(cols, now + i))
-        if len(pending) >= 16:
-            resolve_ticks(pending)
-            pending.clear()
-    resolve_ticks(pending)
-    dt = time.perf_counter() - t0
-    dps = batch * ticks / dt
+    ticks = 48
+    seg_rates = []
+    i = 0
+    for _ in range(3):  # segment medians: see rung_engine's spread note
+        s0 = time.perf_counter()
+        pending = []
+        for _ in range(ticks // 3):
+            pending.append(engine.submit_columns(cols, now + i))
+            i += 1
+            if len(pending) >= 16:
+                resolve_ticks(pending)
+                pending.clear()
+        resolve_ticks(pending)
+        seg_rates.append(
+            batch * (ticks // 3) / max(time.perf_counter() - s0, 1e-9))
+    seg = sorted(seg_rates)
+    dps = seg[1]
     return {
         "rung": label,
         "decisions_per_sec": round(dps, 1),
+        "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
         "vs_unique_key_engine": round(dps / unique_dps, 4) if unique_dps else None,
     }
 
@@ -440,6 +462,86 @@ def rung_herd_device():
     return out
 
 
+def rung_p99_projection():
+    """Device-side p99 evidence at service widths (round-3 verdict #6).
+
+    The tunnel's ~130 ms RTT and 1-8 MB/s links make the 2 ms p99 target
+    unjudgeable end-to-end here, so this rung isolates what the design
+    delivers: chained-differential device tick time at the service batch
+    widths on a 10M-slot table, plus a projected LOCAL p99
+
+        p99_projected_local_ms =
+            host_pack + tick_ms + wire_bytes / 16 GB/s
+
+    Assumptions recorded with the number: dedicated PCIe Gen4 x16
+    (16 GB/s), the measured host columnar pack (~0.084 us/request,
+    docs/tpu-performance.md), compact wire formats (76 B/req down,
+    24 B/decision up), worst-case unique random keys."""
+    from jax import lax
+
+    from gubernator_tpu.ops.engine import (
+        REQ32_INDEX as R32, REQ32_ROWS, make_layout_choice, pack_wide_rows)
+    from gubernator_tpu.ops.rowtable import RowState
+    from gubernator_tpu.ops.buckets import BucketState
+    from gubernator_tpu.ops.tick32 import make_tick32_fn
+
+    capacity = 1 << 20 if FAST else 10_000_000
+    now = 1_700_000_000_000
+    layout = make_layout_choice("auto", capacity, jax.devices()[0], 4096)
+    tick = make_tick32_fn(capacity, layout)
+    zeros = RowState.zeros if layout == "row" else BucketState.zeros
+
+    out = {"rung": "p99_projection", "capacity": capacity,
+           "layout": layout,
+           "assumptions": "PCIe Gen4 x16 16 GB/s; host pack 0.084us/req; "
+                          "compact wire 76B/req + 24B/decision; unique keys"}
+    rng = np.random.default_rng(11)
+    n = 20 if FAST else 60
+    for width in (1024, 4096):
+        m = np.zeros((REQ32_ROWS, width), np.int32)
+        m[R32["slot"]] = np.sort(rng.permutation(capacity)[:width])
+        m[R32["known"]] = 1
+        m[R32["algorithm"]] = rng.integers(0, 2, width)
+        m[R32["valid"]] = 1
+        for name, v in (("hits", 1), ("limit", 10**9),
+                        ("duration", 3_600_000), ("created_at", now)):
+            pack_wide_rows(m, name, np.full(width, v, np.int64),
+                           slice(None))
+        packed = jnp.asarray(m)
+        state = jax.tree.map(jnp.asarray, zeros(capacity))
+
+        def chain(iters, packed=packed):
+            @jax.jit
+            def run(st):
+                def body(i, carry):
+                    s, _ = carry
+                    return tick(s, packed, jnp.int64(now) + i)
+
+                return lax.fori_loop(
+                    0, iters, body,
+                    (st, jnp.zeros((6, width), jnp.int32)))
+
+            return run
+
+        per, spread, _ = diff_time(
+            chain, state, n, lambda out: np.asarray(out[1][:1, :1]))
+        if per is None:
+            out[f"w{width}"] = {"unreliable": True}
+            continue
+        wire_bytes = width * (REQ32_ROWS + 6) * 4
+        pcie_ms = wire_bytes / 16e9 * 1e3
+        host_ms = width * 0.084e-3
+        proj = host_ms + per * 1e3 + pcie_ms
+        out[f"w{width}"] = {
+            "tick_ms": round(per * 1e3, 4),
+            "spread": round(spread, 3),
+            "wire_kb": round(wire_bytes / 1024, 1),
+            "p99_projected_local_ms": round(proj, 4),
+            "vs_2ms_target": round(proj / TARGET_P99_MS, 4),
+        }
+    return out
+
+
 def rung_snapshot(engine, label):
     """Columnar snapshot round-trip (Loader v2: export_columns/
     load_columns — numpy columns + key blob, no per-item dicts)."""
@@ -449,6 +551,11 @@ def rung_snapshot(engine, label):
     snap = engine.export_columns()
     export_s = time.perf_counter() - t0
     items = len(snap["key_offsets"]) - 1
+    # D2H payload: the live slots' 80 B of stored int32 words (the
+    # export unit, engine._jitted_snap_gather) — the record says how
+    # many bytes crossed so a slow-link day is distinguishable from a
+    # regression.
+    d2h_mb = items * 80 / 1e6
     fresh = TickEngine(capacity=engine.capacity, max_batch=engine.max_batch)
     t0 = time.perf_counter()
     fresh.load_columns(snap, now=1_700_000_000_000)
@@ -457,6 +564,8 @@ def rung_snapshot(engine, label):
         "rung": label,
         "items": items,
         "export_s": round(export_s, 2),
+        "export_d2h_mb": round(d2h_mb, 1),
+        "export_mbps": round(d2h_mb / max(export_s, 1e-9), 2),
         "load_s": round(load_s, 2),
     }
 
@@ -683,6 +792,37 @@ async def _service_bench(n_batches, batch, concurrency):
         await client.close()
         await d.close()
     p50, p99 = _pcts(lat)
+
+    # The serving path's own CPU, measured inline (profiled breakdown in
+    # scripts/service_profile.py: proto decode ~0.06 ms + columns
+    # ~1.5 ms + response build ~1.4 ms + serialize ~0.04 ms per
+    # 1000-item batch): on this harness the tunnel round trip is what
+    # queues, so the record carries the CPU component and a projected
+    # local p99 (same assumptions as the p99_projection rung) beside
+    # the tunnel-bound percentiles.
+    from gubernator_tpu.pb import gubernator_pb2 as pbm
+    from gubernator_tpu.transport import convert as conv
+
+    sample = pbm.GetRateLimitsReq(requests=[
+        pbm.RateLimitReq(name="svc", unique_key=f"k{i}", hits=1,
+                         limit=1_000_000, duration=3_600_000)
+        for i in range(batch)
+    ])
+    wire = sample.SerializeToString()
+    cpu_best = 1e9
+    for _ in range(5):
+        c0 = time.perf_counter()
+        msg = pbm.GetRateLimitsReq.FromString(wire)
+        cols, _e, _s = conv.columns_from_pb(msg.requests)
+        z = [0] * batch
+        resp_pb = pbm.GetRateLimitsResp(responses=[
+            pbm.RateLimitResp(status=0, limit=1, remaining=1, reset_time=1)
+            for _ in range(batch)
+        ])
+        resp_pb.SerializeToString()
+        cpu_best = min(cpu_best, time.perf_counter() - c0)
+    cpu_ms = cpu_best * 1e3
+
     return {
         "rung": "service_grpc",
         "batch": batch,
@@ -690,6 +830,12 @@ async def _service_bench(n_batches, batch, concurrency):
         "batches_per_sec": round(n_batches / dt, 1),
         "batch_p50_ms": round(p50, 3),
         "batch_p99_ms": round(p99, 3),
+        "serve_cpu_ms_per_batch": round(cpu_ms, 2),
+        # projected local batch p99: this bench's 8 concurrent batches
+        # serialize on one serving core (worst case: a batch waits out
+        # all 7 peers' CPU) + a ~1 ms device tick at this width +
+        # sub-ms PCIe (p99_projection rung's assumptions)
+        "batch_p99_projected_local_ms": round(concurrency * cpu_ms + 1.2, 2),
         "vs_ref_2k_reqs_per_node": round((n_batches * batch / dt) / 2000.0, 1),
     }
 
@@ -726,15 +872,29 @@ def child_mesh_tick():
             for k in rng.integers(0, 1 << 15, n_nodes * batch)
         ]
 
+    from gubernator_tpu.ops.engine import resolve_ticks
+    from gubernator_tpu.ops.reqcols import ReqColumns
+
     eng.process(window(), now=1_700_000_000_000)  # warm/compile
-    windows = [window() for _ in range(4)]
+    windows = [
+        ReqColumns.from_requests(window()) for _ in range(4)
+    ]
     iters = 5 if FAST else 20
     t0 = time.perf_counter()
     done = 0
+    pending = []
     for i in range(iters):
         w = windows[i % len(windows)]
-        eng.process(w, now=1_700_000_000_000 + i)
+        # The round-3 verdict's ask: the mesh rung rides the columnar
+        # submit_cols path (chunked ≤ max_batch ticks, dispatch
+        # pipelined, many windows resolved per D2H).
+        pending.extend(
+            eng.submit_cols(w, now=1_700_000_000_000 + i).handles())
         done += len(w)
+        if len(pending) >= 16:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
     dt = time.perf_counter() - t0
     print(
         json.dumps(
@@ -931,6 +1091,7 @@ def main():
     ))
     big_p99 = ladder[-1].get("p99_ms")
 
+    ladder.append(_safe("p99_projection", rung_p99_projection))
     ladder.append(_safe("herd_device", rung_herd_device))
     ladder.append(_safe(
         "herd_token_4096", lambda: rung_herd(unique_dps, 0, "herd_token_4096")
@@ -980,6 +1141,13 @@ def main():
                     if isinstance(big_p99, (int, float)) else None
                 ),
                 "p99_target_ms": TARGET_P99_MS,
+                # Transport-free device evidence for the 2 ms bar: the
+                # p99_projection rung's 4096-wide projected-local figure.
+                "p99_projected_local_ms": next(
+                    (r.get("w4096", {}).get("p99_projected_local_ms")
+                     for r in ladder if r.get("rung") == "p99_projection"),
+                    None,
+                ),
                 "device_roundtrip_ms": rt_ms,
                 "h2d_mbps": h2d_mbps,
                 "d2h_mbps": d2h_mbps,

@@ -98,8 +98,37 @@ def load_library() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
     ]
+    try:  # a stale prebuilt library may predate this symbol
+        lib.guber_crc32_batch.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+        ]
+    except AttributeError:
+        log.debug("native library lacks guber_crc32_batch; rebuild to get it")
     _lib = lib
     return lib
+
+
+def crc32_batch(blob: bytes, offsets: np.ndarray) -> np.ndarray:
+    """zlib-compatible CRC-32 of every key in a packed (blob, offsets)
+    pair — the mesh engine's vectorized key→shard router.  Falls back to
+    a zlib loop when the native library is unavailable."""
+    n = len(offsets) - 1
+    lib = load_library()
+    if lib is None or not hasattr(lib, "guber_crc32_batch"):
+        import zlib
+
+        mv = memoryview(blob)
+        return np.fromiter(
+            (zlib.crc32(mv[offsets[i]:offsets[i + 1]]) for i in range(n)),
+            np.uint32, count=n,
+        )
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = np.empty(n, np.uint32)
+    lib.guber_crc32_batch(blob, offsets, n, out)
+    return out
 
 
 class NativeSlotMap:

@@ -62,13 +62,14 @@ from gubernator_tpu.ops.engine import (
     make_readback_fn,
     make_restore_fn,
     make_tick_fn,
-    pack_request_matrix32,
     pad_pow2,
-    resolve_gregorian,
     select_reclaim_victims,
+    split_i64,
 )
+from gubernator_tpu.ops.reqcols import CREATED_UNSET
 from gubernator_tpu.ops.rowtable import ROW_W, RowState
-from gubernator_tpu.types import GlobalUpdate, RateLimitRequest, RateLimitResponse
+from gubernator_tpu.types import (
+    Behavior, GlobalUpdate, RateLimitRequest, RateLimitResponse)
 from gubernator_tpu.utils import timeutil
 
 
@@ -194,6 +195,60 @@ class ShardedOps:
 
     def put3(self, blk: np.ndarray):
         return jax.device_put(blk, self.block_sharding3)
+
+
+class MeshTickHandle:
+    """One dispatched mesh tick: device work queued, host readback
+    deferred — duck-compatible with :class:`ops.engine.TickHandle` so
+    ``resolve_ticks`` can stack mesh and single-chip responses alike.
+
+    ``result()`` materializes the (5, n) response matrix in request
+    order (rows: status, limit, remaining, reset_time, over_limit)."""
+
+    __slots__ = ("_engine", "_resp", "_n", "_sh", "_ps", "errors",
+                 "_limit_req", "_wt_args", "_done")
+
+    def __init__(self, engine, resp, n, sh, ps, errors, limit_req, wt_args):
+        self._engine = engine
+        self._resp = resp
+        self._n = n
+        self._sh = sh
+        self._ps = ps          # per-request (shard, block position); -1 = error
+        self.errors = errors
+        # Copied: callers may reuse their ReqColumns buffers between
+        # submit and resolve (the pipelining pattern).
+        self._limit_req = np.array(limit_req[:n], np.int64, copy=True)
+        self._wt_args = wt_args
+        self._done: Optional[np.ndarray] = None
+
+    def _finish(self, raw: np.ndarray) -> None:
+        if self._done is not None:
+            return
+        n = self._n
+        ok = self._ps >= 0
+        shs = np.where(ok, self._sh, 0)
+        pss = np.where(ok, self._ps, 0)
+        out = np.empty((5, n), np.int64)
+        out[0] = raw[shs, 0, pss]
+        out[1] = self._limit_req
+        out[2] = join_i32_pair(raw[shs, 2, pss], raw[shs, 3, pss])
+        out[3] = join_i32_pair(raw[shs, 4, pss], raw[shs, 5, pss])
+        out[4] = raw[shs, 1, pss]
+        eng = self._engine
+        with eng._lock:
+            if self._done is not None:  # cross-thread race: run once
+                return
+            # error rows carry guard-row garbage: mask before counting
+            eng.metric_over_limit += int(out[4][ok].sum())
+            if eng.store is not None and self._wt_args is not None:
+                eng._write_through(*self._wt_args)
+            self._resp = None
+            self._done = out
+
+    def result(self):
+        if self._done is None:
+            self._finish(np.asarray(self._resp))
+        return self._done, self.errors
 
 
 class MeshTickEngine:
@@ -342,172 +397,237 @@ class MeshTickEngine:
             self.state = self.ops.evict(self.state, self.ops.put2(blk))
 
     # ------------------------------------------------------------------
-    # The tick
+    # The tick — columnar, pipelined (the round-3 TickEngine host path,
+    # uniform across however many shards exist: workers.go:125-147)
     # ------------------------------------------------------------------
+    def submit_columns(
+        self, cols, now: Optional[int] = None
+    ) -> "MeshTickHandle":
+        """Build + dispatch one blocked mesh tick (≤ max_batch rows) and
+        return a handle; device work is queued, not awaited, so host
+        packing of the next tick overlaps device execution of this one
+        (TickEngine.submit_columns's contract, shard-blocked).
+
+        Host path is fully vectorized: one native CRC-32 batch routes
+        keys to shards, per-shard native blob resolves assign slots, one
+        argsort by (shard, slot) establishes each shard's sorted-input
+        contract, and every request-matrix row is one fancy-indexed
+        numpy write.  Keys whose shard stays full after reclaim become
+        per-item errors (the reference's error-in-item convention)."""
+        from gubernator_tpu.native import crc32_batch
+        from gubernator_tpu.ops.reqcols import ReqColumns
+
+        n = len(cols)
+        if n > self.max_batch:
+            raise ValueError(
+                f"batch of {n} exceeds engine max {self.max_batch}")
+        with self._lock:
+            now = now if now is not None else timeutil.now_ms()
+            self._tick_count += 1
+            errors: Dict[int, str] = {}
+
+            # Host-side Gregorian resolution (flagged rows only).
+            GREG = int(Behavior.DURATION_IS_GREGORIAN)
+            greg_e = np.zeros(n, np.int64)
+            greg_d = np.zeros(n, np.int64)
+            greg = cols.behavior & GREG
+            if greg.any():
+                for i in np.flatnonzero(greg):
+                    try:
+                        d = int(cols.duration[i])
+                        greg_e[i] = timeutil.gregorian_expiration(now, d)
+                        greg_d[i] = timeutil.gregorian_duration(now, d)
+                    except timeutil.GregorianError as exc:
+                        errors[int(i)] = str(exc)
+
+            # Key → shard (vectorized CRC-32 over the packed key blob,
+            # bit-identical to the scalar _shard_of router).
+            sh = (
+                crc32_batch(cols.key_blob, cols.key_offsets)
+                % np.uint32(self.n_shards)
+            ).astype(np.int64)
+
+            # Per-shard native resolve: regroup the key blob by shard
+            # with one byte-gather, then one resolve_blob per shard.
+            order = np.argsort(sh, kind="stable")
+            offs = np.asarray(cols.key_offsets, np.int64)
+            lens = np.diff(offs)
+            lo = lens[order]
+            so = offs[:-1][order]
+            cum = np.cumsum(lo)
+            blob_arr = np.frombuffer(cols.key_blob, np.uint8)
+            if len(blob_arr):
+                gather = (
+                    np.arange(int(cum[-1]), dtype=np.int64)
+                    - np.repeat(cum - lo, lo)
+                    + np.repeat(so, lo)
+                )
+                grouped_blob = blob_arr[gather].tobytes()
+            else:
+                grouped_blob = b""
+            g_offsets = np.concatenate(
+                [np.zeros(1, np.int64), cum]
+            )
+            shard_sorted = sh[order]
+            starts = np.searchsorted(shard_sorted, np.arange(self.n_shards + 1))
+
+            slots = np.full(n, -1, np.int64)
+            known = np.zeros(n, np.uint8)
+            for s in range(self.n_shards):
+                a, z = int(starts[s]), int(starts[s + 1])
+                if a == z:
+                    continue
+                rows_s = order[a:z]
+                off_s = g_offsets[a:z + 1] - g_offsets[a]
+                blob_s = grouped_blob[g_offsets[a]:g_offsets[z]]
+                sm = self.slots[s]
+                sl, kn = sm.resolve_blob(blob_s, off_s)
+                if (sl < 0).any():
+                    # Stamp already-resolved rows live before reclaiming
+                    # (an unstamped reclaim could hand a just-resolved
+                    # slot to the retried keys).
+                    okm = sl >= 0
+                    g = s * self.local_capacity + sl[okm]
+                    self._last_access[g] = self._tick_count
+                    self._pending.update(g[kn[okm] == 0].tolist())
+                    self._reclaim(s, now)
+                    retry = np.flatnonzero(sl < 0)
+                    s2, k2 = sm.resolve_batch(
+                        [cols.key_bytes(int(rows_s[t])) for t in retry])
+                    sl[retry] = s2
+                    kn[retry] = k2
+                    for t in np.flatnonzero(sl < 0):
+                        errors[int(rows_s[t])] = (
+                            "rate-limit shard full; eviction failed")
+                slots[rows_s] = sl
+                known[rows_s] = kn
+
+            resolved = slots >= 0
+            g_res = sh[resolved] * self.local_capacity + slots[resolved]
+            self._last_access[g_res] = self._tick_count
+            self._pending.update(g_res[known[resolved] == 0].tolist())
+
+            miss_like = resolved & (known == 0)
+            if self.store is not None and self._pending:
+                g_all = sh * self.local_capacity + np.maximum(slots, 0)
+                pend = self._pending
+                miss_like = miss_like | (resolved & np.fromiter(
+                    (int(g) in pend for g in g_all), np.bool_, n))
+            n_res = int(resolved.sum())
+            n_miss = int(miss_like.sum())
+            self.metric_hits += n_res - n_miss
+            self.metric_misses += n_miss
+            if self.store is not None and n_miss:
+                if cols.refs is None:
+                    raise ValueError(
+                        "Store read-through needs request objects; build "
+                        "the batch with ReqColumns.from_requests(..., "
+                        "keep_refs=True)")
+                self._read_through(
+                    cols.refs, list(range(n)), sh, slots, known,
+                    np.flatnonzero(miss_like), now)
+
+            # Per-shard sorted-input contract: one argsort by
+            # (shard, slot); error rows sort to each shard's end.
+            safe_slots = np.where(resolved, slots, self.local_capacity)
+            order2 = np.argsort(
+                sh * (self.local_capacity + 1) + safe_slots, kind="stable")
+            sh2 = sh[order2]
+            pos_sorted = np.arange(n, dtype=np.int64) - np.searchsorted(
+                sh2, np.arange(self.n_shards + 1))[sh2]
+            ps = np.full(n, -1, np.int64)
+            ps[order2] = pos_sorted
+
+            w = self.max_batch
+            m = np.zeros((self.n_shards, REQ32_ROWS, w), np.int32)
+            m[:, REQ32_INDEX["slot"], :] = self.local_capacity
+            R = REQ32_INDEX
+            ok = resolved.copy()
+            for i in errors:
+                ok[i] = False
+            ix = np.flatnonzero(ok)
+            nodes, sel_ps = sh[ix], ps[ix]
+            m[nodes, R["slot"], sel_ps] = slots[ix]
+            m[nodes, R["known"], sel_ps] = known[ix]
+            m[nodes, R["algorithm"], sel_ps] = cols.algorithm[ix]
+            m[nodes, R["behavior"], sel_ps] = cols.behavior[ix]
+            m[nodes, R["valid"], sel_ps] = 1
+
+            def put_wide(name, vals):
+                lo32, hi32 = split_i64(np.asarray(vals, np.int64))
+                r = R[name]
+                m[nodes, r, sel_ps] = lo32
+                m[nodes, r + 1, sel_ps] = hi32
+
+            put_wide("hits", cols.hits[ix])
+            put_wide("limit", cols.limit[ix])
+            put_wide("duration", cols.duration[ix])
+            ca = cols.created_at[ix]
+            put_wide("created_at", np.where(ca != CREATED_UNSET, ca, now))
+            put_wide("burst", cols.burst[ix])
+            put_wide("greg_exp", greg_e[ix])
+            put_wide("greg_dur", greg_d[ix])
+
+            self.state, resp = self.ops.tick(
+                self.state, self.ops.put3(m), jnp.int64(now)
+            )
+            self._pending.clear()
+            wt_args = None
+            if self.store is not None:
+                wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
+            handle = MeshTickHandle(
+                self, resp, n, sh, np.where(ok, ps, -1), errors,
+                limit_req=cols.limit, wt_args=wt_args,
+            )
+            if self.store is not None:
+                handle.result()
+            return handle
+
+    def submit_cols(self, cols, now: Optional[int] = None):
+        """Dispatch a columnar batch of any width (chunked into
+        max_batch ticks; chunk k+1 packs while chunk k executes)."""
+        from gubernator_tpu.ops.engine import SubmittedBatch
+
+        n = len(cols)
+        now = now if now is not None else timeutil.now_ms()
+        spans = [
+            (s, min(s + self.max_batch, n))
+            for s in range(0, n, self.max_batch)
+        ]
+        handles = [
+            self.submit_columns(
+                cols if len(spans) == 1 else cols.slice_chunk(s, e), now
+            )
+            for s, e in spans
+        ]
+        return SubmittedBatch(handles, spans, n)
+
+    def submit(
+        self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
+    ):
+        """Object-level dispatch without awaiting the device (the tick
+        loop's pipelining hook)."""
+        from gubernator_tpu.ops.reqcols import ReqColumns
+
+        return self.submit_cols(
+            ReqColumns.from_requests(
+                requests, keep_refs=self.store is not None
+            ),
+            now,
+        )
+
+    def process_columns(self, cols, now: Optional[int] = None):
+        if len(cols) == 0:
+            return np.zeros((5, 0), np.int64), {}
+        return self.submit_cols(cols, now).matrix()
+
     def process(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ) -> List[RateLimitResponse]:
-        """Apply a batch of requests; responses come back in request order.
-
-        Requests that don't fit this tick's per-shard blocks (global
-        overflow or hash skew) spill into follow-up ticks — the multi-chunk
-        analog of TickEngine's chunk loop.
-        """
+        """Apply a batch of requests; responses in request order."""
         if not requests:
             return []
-        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
-        with self._lock:
-            now = now if now is not None else timeutil.now_ms()
-            todo = list(range(len(requests)))
-            while todo:
-                left = self._tick_once(requests, todo, out, now)
-                if left == todo:  # no progress: shard genuinely full
-                    for i in left:
-                        out[i] = RateLimitResponse(
-                            error="rate-limit shard full; eviction failed"
-                        )
-                    break
-                todo = left
-        return out
-
-    def _tick_once(
-        self,
-        requests: Sequence[RateLimitRequest],
-        todo: List[int],
-        out: List[Optional[RateLimitResponse]],
-        now: int,
-    ) -> List[int]:
-        """Run one device tick over as many of ``todo`` as fit; return spill.
-
-        Packing is column-vectorized like TickEngine.build_batch: one
-        Python pass collects request fields, keys resolve in one native
-        batch per shard (reclaim + retry on a full shard), and every
-        request-matrix row is one fancy-indexed numpy write."""
-        b = self.max_batch
-        self._tick_count += 1
-
-        # One attribute pass: gregorian, key, shard.
-        idx: List[int] = []
-        keys: List[str] = []
-        shard_l: List[int] = []
-        greg_e: List[int] = []
-        greg_d: List[int] = []
-        for i in todo:
-            r = requests[i]
-            try:
-                ge, gd = resolve_gregorian(r, now)
-            except timeutil.GregorianError as e:
-                out[i] = RateLimitResponse(error=str(e))
-                continue
-            k = r.hash_key()
-            idx.append(i)
-            keys.append(k)
-            shard_l.append(self._shard_of(k))
-            greg_e.append(ge)
-            greg_d.append(gd)
-        if not idx:
-            return []
-        n = len(idx)
-        shards = np.asarray(shard_l, np.int64)
-
-        # Resolve keys shard by shard in one native batch each.
-        slots = np.full(n, -1, np.int64)  # local slot within the shard
-        known = np.zeros(n, np.uint8)
-        pos = np.full(n, -1, np.int64)
-        for s in np.unique(shards):
-            sel = np.flatnonzero(shards == s)
-            kb = [keys[j].encode() for j in sel]
-            sm = self.slots[s]
-            sl, kn = sm.resolve_batch(kb)
-            if (sl < 0).any():
-                # Stamp already-resolved rows live before reclaiming
-                # (see TickEngine.build_batch: an unstamped reclaim could
-                # hand a just-resolved slot to the retried keys).
-                okm = sl >= 0
-                g = s * self.local_capacity + sl[okm]
-                self._last_access[g] = self._tick_count
-                self._pending.update(g[kn[okm] == 0].tolist())
-                self._reclaim(s, now)
-                retry = np.flatnonzero(sl < 0)
-                s2, k2 = sm.resolve_batch([kb[t] for t in retry])
-                sl[retry] = s2
-                kn[retry] = k2
-            slots[sel] = sl
-            known[sel] = kn
-            # Arrival-order position within the shard, assigned only to
-            # requests whose key resolved: a full shard's failures must
-            # not burn block columns that later resolvable requests need
-            # (they spill; resolved overflow past the block width spills
-            # too and retries with its slot already assigned).
-            rs = sel[sl >= 0]
-            pos[rs] = np.arange(len(rs))
-
-        # Stamp EVERY resolved row live — including block-overflow spills
-        # (pos >= b): their slots are assigned but unwritten until the
-        # retry tick, and an unstamped reclaim (e.g. from install_globals
-        # between calls) could unmap a slot whose spill retry is pending.
-        resolved = slots >= 0
-        g_res = shards[resolved] * self.local_capacity + slots[resolved]
-        self._last_access[g_res] = self._tick_count
-        self._pending.update(g_res[known[resolved] == 0].tolist())
-        ok = resolved & (pos >= 0) & (pos < b)
-        # New slots of spilled rows must survive the post-tick pending
-        # clear: this tick does not write them.
-        spilled_new = resolved & ~ok & (known == 0)
-        g_spill_new = shards[spilled_new] * self.local_capacity + slots[spilled_new]
-        spill = [idx[j] for j in np.flatnonzero(~ok)]
-        sel = np.flatnonzero(ok)
-        if len(sel) == 0:
-            return spill
-
-        miss_like = known[sel] == 0
-        if self.store is not None and self._pending:
-            # A block-overflow spill's fresh slot re-resolves as known=1 on
-            # its retry tick, but the device never wrote it — it is still
-            # in _pending.  Those rows must read-through too, or persisted
-            # state is silently dropped for exactly the spilled keys.
-            g_sel = shards[sel] * self.local_capacity + slots[sel]
-            pend = self._pending
-            miss_like = miss_like | np.fromiter(
-                (int(g) in pend for g in g_sel), np.bool_, len(g_sel)
-            )
-        miss_sel = sel[miss_like]
-        self.metric_hits += len(sel) - len(miss_sel)
-        self.metric_misses += len(miss_sel)
-        if self.store is not None and len(miss_sel):
-            self._read_through(requests, idx, shards, slots, known, miss_sel, now)
-
-        m = np.zeros((self.n_shards, REQ32_ROWS, b), np.int32)
-        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
-        sh, ps = shards[sel], pos[sel]
-        sel_reqs = [requests[idx[j]] for j in sel]
-        pack_request_matrix32(
-            m, ps, sel_reqs, slots[sel], known[sel],
-            now, nodes=sh,
-            greg=(np.asarray(greg_e, np.int64)[sel],
-                  np.asarray(greg_d, np.int64)[sel]),
-        )
-
-        self.state, resp = self.ops.tick(
-            self.state, self.ops.put3(m), jnp.int64(now)
-        )
-        self._pending.clear()
-        self._pending.update(g_spill_new.tolist())
-        rm = np.asarray(resp)  # (n_shards, 6, B) int32 (compact format)
-        self.metric_over_limit += int(rm[sh, 1, ps].sum())
-        if self.store is not None:
-            self._write_through(requests, idx, sel, shards, slots, now)
-        status = rm[sh, 0, ps].tolist()
-        remaining = join_i32_pair(rm[sh, 2, ps], rm[sh, 3, ps]).tolist()
-        reset = join_i32_pair(rm[sh, 4, ps], rm[sh, 5, ps]).tolist()
-        for t, j in enumerate(sel):
-            out[idx[j]] = RateLimitResponse(
-                status=status[t],
-                limit=sel_reqs[t].limit,  # the echo (see pack_resp_compact)
-                remaining=remaining[t],
-                reset_time=reset[t],
-            )
-        return spill
+        return self.submit(requests, now).responses()
 
     @staticmethod
     def _blocked_chunks(per_shard):

@@ -32,10 +32,20 @@ from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 
 # How many dispatched-but-unresolved windows may be in flight.  2 is full
-# double-buffering; a little deeper rides out D2H jitter.  The bound is the
-# backpressure: when the device falls behind, dispatch blocks here instead
-# of queueing unbounded work.
-PIPELINE_DEPTH = 4
+# double-buffering; deeper rides out D2H jitter AND matters directly on
+# high-RTT links: the resolver drains every queued window into ONE
+# device-to-host transfer, so depth bounds how many windows amortize each
+# round trip (profiled: the serving path's CPU is ~3 ms/1000-item batch;
+# the round trip is what queues).  The bound is the backpressure: when
+# the device falls behind, dispatch blocks here instead of queueing
+# unbounded work.  GUBER_TICK_PIPELINE_DEPTH overrides.
+import os as _os
+
+try:
+    PIPELINE_DEPTH = max(1, int(_os.environ.get(
+        "GUBER_TICK_PIPELINE_DEPTH", "4")))
+except ValueError:
+    PIPELINE_DEPTH = 4
 
 
 def _complete(fut: Future, result) -> None:
@@ -165,27 +175,16 @@ class TickLoop:
                 reqs.extend(payload)
                 obj_items.append((n, fut))
 
-        submit = getattr(self.engine, "submit", None)
-        if submit is None:
-            # Engines without the dispatch/resolve split (mesh engine):
-            # synchronous fallback, resolved inline; columnar submissions
-            # are not routed here (the fast path requires submit_cols).
-            if col_items:
-                _fail_waiters(
-                    col_items,
-                    RuntimeError("engine does not support columnar batches"),
-                )
-            try:
-                out = self.engine.process(reqs)
-            except Exception as e:  # engine failure fails every waiter
-                _fail_waiters(obj_items, e)
-                return
-            self._deliver(obj_items, out, len(reqs), time.perf_counter() - t0)
-            return
+        # Every engine (single-chip TickEngine AND the sharded
+        # MeshTickEngine) speaks the dispatch/resolve split: submissions
+        # queue device work and the resolver thread materializes many
+        # windows in one D2H.  There is deliberately no synchronous
+        # fallback — an engine without submit/submit_cols is a bug.
         subs = []
         if reqs:
             try:
-                subs.append(("obj", submit(reqs), obj_items, len(reqs)))
+                subs.append(("obj", self.engine.submit(reqs), obj_items,
+                             len(reqs)))
             except Exception as e:
                 _fail_waiters(obj_items, e)
         if col_parts:
@@ -329,7 +328,24 @@ class TickLoop:
         self._thread.join(timeout=5)
         if self._thread.is_alive():
             # Dispatch thread wedged (e.g. blocked on a full resolve queue
-            # with a dead resolver): don't hang close(); the daemon process
-            # is going down anyway.
+            # with a dead resolver): don't hang close() — but don't leave
+            # queued waiters hanging forever either; fail everything
+            # still pending so callers awaiting wrap_future() return.
+            with self._cond:
+                stuck = self._pending
+                self._pending = []
+                self._pending_count = 0
+            err = RuntimeError("tick loop shut down with requests pending")
+            _fail_waiters([(n, fut) for _, _, n, fut in stuck], err)
+            while True:
+                try:
+                    item = self._resolve_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                subs, _ = item
+                for _, _, items, _ in subs:
+                    _fail_waiters(items, err)
             return
         self._resolver.join(timeout=5)
