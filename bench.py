@@ -97,6 +97,31 @@ def diff_time(chain, state, n, resolve, attempts=5, spread_goal=0.20):
 # ----------------------------------------------------------------------
 # Rung 1: device kernel ceiling
 # ----------------------------------------------------------------------
+def _tick_for_chain(capacity, layout, batch):
+    """(tick_fn, zero_resp_carry) for a chained-fori_loop rung.  The XLA
+    tick variants carry the response as six unstacked rows: stacking
+    inside the loop would hand XLA:CPU a concatenate-rooted mega-fusion
+    it emits as a per-element tree walk (~0.2 s/element — see
+    ops/tick32.make_tick32_rows_fn), which would make the CPU fast-mode
+    CI gate unusable.  The fused Pallas row kernel packs its (6, B)
+    response in-kernel and carries the matrix."""
+    from gubernator_tpu.ops.tick32 import (
+        _resolve_fused, make_tick32_fn, make_tick32_rows_fn)
+
+    if layout == "row" and _resolve_fused(None):
+        return (make_tick32_fn(capacity, layout),
+                jnp.zeros((6, batch), jnp.int32))
+    return (make_tick32_rows_fn(capacity, layout),
+            tuple(jnp.zeros(batch, jnp.int32) for _ in range(6)))
+
+
+def _resolve_chain(out):
+    """Materialize one element of the chained run's response carry (works
+    for both the (6, B) matrix and the six-row-tuple carry)."""
+    leaf = jax.tree.leaves(out[1])[0]
+    return np.asarray(leaf[(slice(0, 1),) * leaf.ndim])
+
+
 def rung_kernel():
     from jax import lax
 
@@ -104,7 +129,6 @@ def rung_kernel():
     from gubernator_tpu.ops.engine import (
         REQ32_INDEX as R32, REQ32_ROWS, make_layout_choice)
     from gubernator_tpu.ops.rowtable import RowState
-    from gubernator_tpu.ops.tick32 import make_tick32_fn
 
     capacity = 1 << 20
     batch = 1 << 15
@@ -126,7 +150,7 @@ def rung_kernel():
         pack_wide_rows(m, name, np.full(batch, v, np.int64), slice(None))
 
     layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
-    tick = make_tick32_fn(capacity, layout)
+    tick, zero_resp = _tick_for_chain(capacity, layout, batch)
     zeros = RowState.zeros if layout == "row" else BucketState.zeros
     state = jax.tree.map(jnp.asarray, zeros(capacity))
     packed = jnp.asarray(m)
@@ -148,17 +172,14 @@ def rung_kernel():
                 s, _ = carry
                 return tick(s, packed, jnp.int64(now) + i)
 
-            return lax.fori_loop(
-                0, iters, body, (st, jnp.zeros((6, batch), jnp.int32))
-            )
+            return lax.fori_loop(0, iters, body, (st, zero_resp))
 
         return run
 
     n = 20 if FAST else 100
     # Median-of-k with recorded spread (round-3 verdict: single-shot
     # differentials carried unquantified noise).
-    per_tick, spread, samples = diff_time(
-        chain, state, n, lambda out: np.asarray(out[1][:1, :1]))
+    per_tick, spread, samples = diff_time(chain, state, n, _resolve_chain)
     if per_tick is None:
         # Tunnel jitter swamped the differentials (non-positive samples):
         # a spike in the short chain's best makes the long chain look
@@ -483,12 +504,10 @@ def rung_p99_projection():
         REQ32_INDEX as R32, REQ32_ROWS, make_layout_choice, pack_wide_rows)
     from gubernator_tpu.ops.rowtable import RowState
     from gubernator_tpu.ops.buckets import BucketState
-    from gubernator_tpu.ops.tick32 import make_tick32_fn
 
     capacity = 1 << 20 if FAST else 10_000_000
     now = 1_700_000_000_000
     layout = make_layout_choice("auto", capacity, jax.devices()[0], 4096)
-    tick = make_tick32_fn(capacity, layout)
     zeros = RowState.zeros if layout == "row" else BucketState.zeros
 
     out = {"rung": "p99_projection", "capacity": capacity,
@@ -509,22 +528,20 @@ def rung_p99_projection():
                            slice(None))
         packed = jnp.asarray(m)
         state = jax.tree.map(jnp.asarray, zeros(capacity))
+        tick, zero_resp = _tick_for_chain(capacity, layout, width)
 
-        def chain(iters, packed=packed):
+        def chain(iters, packed=packed, tick=tick, zero_resp=zero_resp):
             @jax.jit
             def run(st):
                 def body(i, carry):
                     s, _ = carry
                     return tick(s, packed, jnp.int64(now) + i)
 
-                return lax.fori_loop(
-                    0, iters, body,
-                    (st, jnp.zeros((6, width), jnp.int32)))
+                return lax.fori_loop(0, iters, body, (st, zero_resp))
 
             return run
 
-        per, spread, _ = diff_time(
-            chain, state, n, lambda out: np.asarray(out[1][:1, :1]))
+        per, spread, _ = diff_time(chain, state, n, _resolve_chain)
         if per is None:
             out[f"w{width}"] = {"unreliable": True}
             continue
@@ -551,11 +568,12 @@ def rung_snapshot(engine, label):
     snap = engine.export_columns()
     export_s = time.perf_counter() - t0
     items = len(snap["key_offsets"]) - 1
-    # D2H payload: the live slots' 80 B of stored int32 words (the
-    # export unit, engine._jitted_snap_gather) — the record says how
-    # many bytes crossed so a slow-link day is distinguishable from a
-    # regression.
-    d2h_mb = items * 80 / 1e6
+    # D2H payload: what the schema-specialized export actually moved
+    # (engine.last_export_stats) — the record says how many bytes
+    # crossed so a slow-link day is distinguishable from a regression.
+    d2h_mb = getattr(engine, "last_export_stats", {}).get(
+        "d2h_bytes", items * 80
+    ) / 1e6
     fresh = TickEngine(capacity=engine.capacity, max_batch=engine.max_batch)
     t0 = time.perf_counter()
     fresh.load_columns(snap, now=1_700_000_000_000)

@@ -100,6 +100,36 @@ class Config:
             self.cache_size = 50_000
 
 
+# GLOBAL-mesh reconcile envelope (parallel/global_mesh.py module doc):
+# every reconcile all-gathers O(capacity * n_nodes) state and applies the
+# transition to EVERY slot, every sync interval, independent of traffic.
+# Past ~2^20 slots that dense pass stops fitting a 100 ms cadence (and at
+# 2^24 a single step moves gigabytes over ICI), so the config surface
+# warns at the documented soft bound and refuses the hard one instead of
+# letting a typo configure an unserviceable mesh.
+GLOBAL_MESH_CAPACITY_SOFT = 1 << 20
+GLOBAL_MESH_CAPACITY_HARD = 1 << 24
+
+
+def validate_global_mesh_capacity(capacity: int) -> None:
+    if capacity > GLOBAL_MESH_CAPACITY_HARD:
+        raise ValueError(
+            f"GUBER_TPU_GLOBAL_MESH_CAPACITY={capacity} exceeds "
+            f"{GLOBAL_MESH_CAPACITY_HARD} (2^24); the dense reconcile "
+            "moves O(capacity * nodes) bytes over ICI every sync interval "
+            "and cannot serve tables this large — GLOBAL limits are a "
+            "small hot subset; shard the serving table instead "
+            "(parallel/global_mesh.py scaling envelope)"
+        )
+    if capacity > GLOBAL_MESH_CAPACITY_SOFT:
+        log.warning(
+            "GUBER_TPU_GLOBAL_MESH_CAPACITY=%d is past the documented "
+            "envelope (2^14-2^20): each reconcile densely rewrites every "
+            "slot on every node — expect the sync cadence to stretch "
+            "(parallel/global_mesh.py scaling envelope)", capacity,
+        )
+
+
 # Metric-collector flags (reference flags.go:20-23).  "os" registers a
 # process collector (RSS, fds, CPU via /proc); "golang" — kept under the
 # reference's name so GUBER_METRIC_FLAGS values carry over — registers the
@@ -336,6 +366,7 @@ def setup_daemon_config(
             f"GUBER_TPU_BG_RECLAIM must be auto, on, or off; "
             f"got {conf.tpu_bg_reclaim!r}"
         )
+    validate_global_mesh_capacity(conf.tpu_global_mesh_capacity)
     if conf.local_picker_hash not in ("fnv1", "fnv1a"):
         raise ValueError(
             f"GUBER_PEER_PICKER_HASH is invalid; choose one of 'fnv1', 'fnv1a'"

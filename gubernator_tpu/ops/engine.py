@@ -428,6 +428,18 @@ def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
     return out
 
 
+def masked_over_limit(resp_mat: np.ndarray, errors) -> int:
+    """Over-limit count from a public (5, n) response matrix with the
+    per-item-error lanes zeroed first — their values are unspecified in
+    the device response (on the row layout they gather guard-row
+    garbage; see unpack_resp_compact)."""
+    over = resp_mat[4]
+    if errors:
+        over = over.copy()
+        over[list(errors)] = 0
+    return int(over.sum())
+
+
 def _apply_merged_followers(
     new_g: BucketState,
     resp: RespBatch,
@@ -1049,6 +1061,130 @@ SNAP_FIELDS = (
 )
 
 
+# Wide (int64) snapshot fields, in SNAP_FIELDS order, minus the narrow
+# algorithm/status columns — the unit of the slim-transfer schema below.
+SNAP_WIDE = (
+    "limit", "remaining", "duration", "created_at", "updated_at",
+    "burst", "expire_at",
+)
+SNAP_CHUNK = 1 << 21  # live rows per export D2H chunk (~44-64 MB each)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_snap_wide(layout: str):
+    """(state, slots (w,) i32) → (ROW_USED, w) i32 stored-word matrix of
+    the gathered slots — the device-side staging buffer the probe/select
+    programs slice.  Padding slots must point at a REAL row (the caller
+    pads with the chunk's first slot) so the probe's range statistics
+    aren't polluted by guard-row zeros."""
+    from gubernator_tpu.ops.buckets import STATE_DTYPES
+
+    if layout == "row":
+
+        def f(state, slots):
+            return state.table[slots, : rowtable.ROW_USED].T
+
+    else:
+
+        def f(state, slots):
+            rows = []
+            for name in STATE_DTYPES:
+                col = getattr(state, name)
+                for p in col if isinstance(col, tuple) else (col,):
+                    c = p[slots]
+                    rows.append(
+                        c if c.dtype == jnp.int32 else c.astype(jnp.int32)
+                    )
+            return jnp.stack(rows)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_snap_probe():
+    """(ROW_USED, w) words → (len(SNAP_WIDE), 3) i32 per-field stats:
+    [all hi words are the lo word's sign extension, min hi, max hi].
+    The export uses them to pick, per chunk, which hi columns need to
+    cross the link at all (verdict r3 #7: the int64 columns were the
+    bytes inflating a ~0.9 GB / 110 s 10M export)."""
+    O = rowtable.FIELD_OFFSETS
+
+    def f(m):
+        out = []
+        for name in SNAP_WIDE:
+            lo, hi = m[O[name]], m[O[name] + 1]
+            out.append(
+                jnp.stack([
+                    jnp.all(hi == (lo >> 31)).astype(jnp.int32),
+                    jnp.min(hi),
+                    jnp.max(hi),
+                ])
+            )
+        return jnp.stack(out)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_snap_select(hi_mask: tuple):
+    """(ROW_USED, w) words → (W, w) transfer matrix: the 7 lo words, the
+    hi words the chunk's probe proved necessary, the 3 remaining_f parts,
+    and one packed algorithm|status|in_use word."""
+    O = rowtable.FIELD_OFFSETS
+
+    def f(m):
+        rows = [m[O[name]] for name in SNAP_WIDE]
+        rows += [
+            m[O[name] + 1]
+            for name, keep in zip(SNAP_WIDE, hi_mask) if keep
+        ]
+        fo = O["remaining_f"]
+        rows += [m[fo], m[fo + 1], m[fo + 2]]
+        rows.append(
+            (m[O["algorithm"]] & 0xFF)
+            | ((m[O["status"]] & 0xFF) << 8)
+            | ((m[O["in_use"]] & 1) << 16)
+        )
+        return jnp.stack(rows)
+
+    return jax.jit(f)
+
+
+def _snap_decode(part, k, probe, hi_mask, sel_np):
+    """One transfer chunk → (kept_slots, {snap_field: column}) with dead
+    (in_use=0) rows dropped.  Inverse of _jitted_snap_select + probe."""
+    mat = sel_np[:, :k]
+    r = len(SNAP_WIDE)
+    his = {}
+    for name, keep in zip(SNAP_WIDE, hi_mask):
+        if keep:
+            his[name] = mat[r]
+            r += 1
+    f32 = mat[r : r + 3]
+    packed = mat[r + 3]
+    alive = ((packed >> 16) & 1).astype(bool)
+    cols: dict = {}
+    for i, name in enumerate(SNAP_WIDE):
+        lo = mat[i]
+        if name in his:
+            hi = his[name].astype(np.int64)
+        else:
+            all_se, hmin, _ = probe[i]
+            if all_se:
+                cols[name] = lo.astype(np.int64)[alive]
+                continue
+            hi = np.int64(hmin)  # probe proved the hi word constant
+        cols[name] = (
+            (hi << 32) | lo.view(np.uint32).astype(np.int64)
+        )[alive]
+    cols["remaining_f"] = sum(
+        w.view(np.float32).astype(np.float64) for w in f32
+    )[alive]
+    cols["algorithm"] = (packed & 0xFF).astype(np.int64)[alive]
+    cols["status"] = ((packed >> 8) & 0xFF).astype(np.int64)[alive]
+    return part[alive], cols
+
+
 def snapshot_from_items(items: Sequence[dict]) -> dict:
     """Loader-contract item dicts → columnar snapshot (the inverse of
     :func:`items_from_snapshot`; the one place the dict→columns
@@ -1389,7 +1525,7 @@ class TickHandle:
     """
 
     __slots__ = ("_engine", "_resp", "_n", "_inv", "errors", "_refs",
-                 "_slots_req", "_limit_req", "_done")
+                 "_slots_req", "_limit_req", "_done", "_flock")
 
     def __init__(self, engine, resp, n, inv, errors, refs, slots_req,
                  limit_req=None):
@@ -1410,27 +1546,29 @@ class TickHandle:
             else np.array(limit_req[:n], np.int64, copy=True)
         )
         self._done: Optional[np.ndarray] = None
+        self._flock = threading.Lock()
 
     def _finish(self, raw: np.ndarray) -> None:
         """Complete from an already-materialized device response matrix:
         (6, W) int32 compact (TickEngine's format — it compiles its tick
         with compact_resp=True and always passes limit_req) or the
         (5, W) int64 legacy layout used by engines that don't."""
-        if self._done is not None:
-            return
-        # The [:, inv] un-permutes the slot-sorted batch.
-        rm = raw[:, : self._n][:, self._inv]
-        if self._limit_req is not None:  # compact → public (5, n) int64
-            rm = unpack_resp_compact(rm, self._limit_req)
-        eng = self._engine
-        with eng._lock:
-            eng.metric_over_limit += int(rm[4].sum())
-            if eng.store is not None:
-                eng._write_through(
-                    self._refs, self._slots_req, self._n, self.errors
-                )
-        self._resp = None  # release the device buffer reference
-        self._done = rm
+        with self._flock:
+            if self._done is not None:
+                return
+            # The [:, inv] un-permutes the slot-sorted batch.
+            rm = raw[:, : self._n][:, self._inv]
+            if self._limit_req is not None:  # compact → public (5, n) int64
+                rm = unpack_resp_compact(rm, self._limit_req)
+            eng = self._engine
+            with eng._lock:
+                eng.metric_over_limit += masked_over_limit(rm, self.errors)
+                if eng.store is not None:
+                    eng._write_through(
+                        self._refs, self._slots_req, self._n, self.errors
+                    )
+            self._resp = None  # release the device buffer reference
+            self._done = rm
 
     def result(self) -> tuple[np.ndarray, Dict[int, str]]:
         if self._done is None:
@@ -2137,24 +2275,83 @@ class TickEngine:
     # ------------------------------------------------------------------
     def export_columns(self) -> dict:
         """Bulk snapshot: numpy columns + one key blob (the Loader v2
-        format; see SNAP_FIELDS).  O(1) Python calls regardless of table
-        size — one D2H of the table, one native key export, one vectorized
-        slice per column.  The reference streams items through a channel
-        (store.go:69-78); the columnar analog of that stream is arrays."""
-        from gubernator_tpu.ops.buckets import slice_field
+        format; see SNAP_FIELDS).  The reference streams items through a
+        channel (store.go:69-78); the columnar analog of that stream is
+        arrays.
 
+        Transfer discipline (verdict r3 #7): only LIVE slots cross the
+        link, as int32 words, and only the words a per-chunk device probe
+        proves necessary — hi words that are sign extensions of their lo
+        (values < 2^31: limits, remainings, sub-25-day durations) are
+        dropped, constant hi words (epoch-ms columns inside one ~50-day
+        window) become one host scalar, and algorithm/status/in_use pack
+        into a single word.  Typical cost: 44 B/item instead of the full
+        table's 80 B/slot.  Chunks pipeline: while chunk i drains over
+        the link, chunk i+1's gather/probe runs on device.
+        ``last_export_stats`` records what actually crossed."""
         with self._lock:
-            if self.layout == "row":
-                st = rowtable.row_host_columns(self.state)
-            else:
-                st = jax.tree.map(np.asarray, self.state)
-            live = np.flatnonzero(self.slots.mapped_mask() & st.in_use)
+            mapped = np.flatnonzero(self.slots.mapped_mask())
+            n = len(mapped)
+            empty = {
+                "key_blob": b"",
+                "key_offsets": np.zeros(1, np.int64),
+                **{
+                    f: np.zeros(
+                        0, np.float64 if f == "remaining_f" else np.int64
+                    )
+                    for f in SNAP_FIELDS
+                },
+            }
+            if n == 0:
+                self.last_export_stats = {"d2h_bytes": 0, "items": 0}
+                return empty
+            w = SNAP_CHUNK if n > SNAP_CHUNK else pad_pow2(n)
+            wide_fn = _jitted_snap_wide(self.layout)
+            probe_fn = _jitted_snap_probe()
+            d2h = 0
+            parts: List[np.ndarray] = []
+            chunks: List[dict] = []
+            prev = None
+            for start in range(0, n, w):
+                part = mapped[start : start + w]
+                k = len(part)
+                slots_pad = np.full(w, part[0], np.int32)
+                slots_pad[:k] = part
+                wide = wide_fn(self.state, jnp.asarray(slots_pad))
+                probe = np.asarray(probe_fn(wide))
+                hi_mask = tuple(
+                    not (bool(probe[i, 0]) or probe[i, 1] == probe[i, 2])
+                    for i in range(len(SNAP_WIDE))
+                )
+                sel = _jitted_snap_select(hi_mask)(wide)
+                del wide
+                d2h += probe.nbytes + int(np.prod(sel.shape)) * 4
+                if prev is not None:
+                    p, cols = _snap_decode(
+                        prev[0], prev[1], prev[2], prev[3],
+                        np.asarray(prev[4]),
+                    )
+                    parts.append(p)
+                    chunks.append(cols)
+                prev = (part, k, probe, hi_mask, sel)
+            p, cols = _snap_decode(
+                prev[0], prev[1], prev[2], prev[3], np.asarray(prev[4])
+            )
+            parts.append(p)
+            chunks.append(cols)
+            live = np.concatenate(parts)
+            if len(live) == 0:
+                self.last_export_stats = {"d2h_bytes": d2h, "items": 0}
+                return empty
             blob, offsets = self.slots.keys_blob(live)
             snap: dict = {"key_blob": blob, "key_offsets": offsets}
             for name in SNAP_FIELDS:
-                snap[name] = np.ascontiguousarray(
-                    np_logical(slice_field(getattr(st, name), live), name)
-                )
+                snap[name] = np.concatenate([c[name] for c in chunks])
+            self.last_export_stats = {
+                "d2h_bytes": d2h,
+                "items": len(live),
+                "bytes_per_item": round(d2h / max(len(live), 1), 1),
+            }
             return snap
 
     def export_items(self) -> List[dict]:

@@ -52,27 +52,53 @@ def now_to_pair(now: jnp.ndarray) -> p64.I64:
     return p64.I64(lo, hi)
 
 
-def make_tick32_fn(capacity: int, layout: str = "columns",
-                   fused: bool | None = None):
-    """Build (state, m32, now) → (state, resp6) for unique-slot batches.
+def _resolve_fused(fused: bool | None) -> bool:
+    """Default: fused Pallas on real TPU, unfused XLA elsewhere.  On CPU
+    the fused kernel only exists in interpret mode (a Python-stepped DMA
+    loop — seconds per tick), so the 8-device test mesh would crawl;
+    GUBER_TPU_FUSED_TICK=0/1 still forces either path on any backend
+    (tests/test_fusedtick.py covers fused-vs-unfused parity in interpret
+    mode explicitly)."""
+    import os
 
-    Contract (matches make_tick_fn's compact in/out so TickHandle code is
-    shared): ``m32`` is the (19, B) compact request matrix, slot-sorted,
-    padding/error rows carrying slot == capacity; at most one valid
-    request per real slot.  ``resp6`` is the (6, B) compact response
-    matrix; rows past the live count are unspecified.
+    if fused is not None:
+        return fused
+    env = os.environ.get("GUBER_TPU_FUSED_TICK")
+    if env is not None:
+        return env != "0"
+    return jax.default_backend() == "tpu"
+
+
+def _resp_rows(resp) -> tuple:
+    """PResp → the six compact response rows, unstacked (same order as
+    presp_to_compact: status, over, rem lo/hi, reset lo/hi)."""
+    return (
+        resp.status,
+        resp.over_limit.astype(I32),
+        resp.remaining.lo,
+        resp.remaining.hi,
+        resp.reset_time.lo,
+        resp.reset_time.hi,
+    )
+
+
+def make_tick32_rows_fn(capacity: int, layout: str = "columns"):
+    """The XLA (non-Pallas) tick program, response as SIX SEPARATE row
+    vectors rather than one stacked (6, B) matrix.
+
+    The split exists because stacking is poison on the CPU backend:
+    XLA:CPU emits a concatenate-rooted fusion over this very deep
+    elementwise graph by recursively re-evaluating each operand's
+    expression tree per output element (no memoization across the
+    diamond-shaped reuse in the i64-pair/triple-f32 arithmetic), which
+    turns a ~10 µs tick into ~0.2 s *per batch element* — a 64-wide tick
+    took 12 s on the 8-device test mesh.  Returning the rows as separate
+    program outputs keeps every fusion root single-output, which XLA
+    emits as one memoized loop.  TPU's emitter doesn't have the
+    pathology, but the two-program composition costs only a dispatch.
     """
 
     if layout == "row":
-        import os
-
-        if fused is None:
-            fused = os.environ.get("GUBER_TPU_FUSED_TICK", "1") != "0"
-        if fused:
-            from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
-
-            return make_fused_tick_fn(capacity)
-
         from gubernator_tpu.ops.rowtable import gather_rows, scatter_rows
 
         def tick(state, m32, now):
@@ -83,7 +109,7 @@ def make_tick32_fn(capacity: int, layout: str = "columns",
             new_g, resp = transition32(now_to_pair(now), s, r)
             scat = jnp.where(r.valid, slots, jnp.int32(capacity))
             table = scatter_rows(state.table, scat, pstate_to_matrix(new_g))
-            return state._replace(table=table), presp_to_compact(resp)
+            return state._replace(table=table), _resp_rows(resp)
 
     else:
 
@@ -95,13 +121,64 @@ def make_tick32_fn(capacity: int, layout: str = "columns",
             # unclipped slot: padding rows (slot == capacity) drop
             scat = jnp.where(r.valid, r.slot, jnp.int32(capacity))
             state = pstate_scatter_columns(state, scat, new_g)
-            return state, presp_to_compact(resp)
+            return state, _resp_rows(resp)
+
+    return tick
+
+
+def make_tick32_fn(capacity: int, layout: str = "columns",
+                   fused: bool | None = None):
+    """Build (state, m32, now) → (state, resp6) for unique-slot batches.
+
+    Contract (matches make_tick_fn's compact in/out so TickHandle code is
+    shared): ``m32`` is the (19, B) compact request matrix, slot-sorted,
+    padding/error rows carrying slot == capacity; at most one valid
+    request per real slot.  ``resp6`` is the (6, B) compact response
+    matrix; rows past the live count are unspecified.
+
+    This single-program form is for callers that need one traceable
+    function (bench chains it inside a fori_loop on TPU).  Engines should
+    use :func:`jitted_tick32`, which splits the response stack into a
+    second program — see make_tick32_rows_fn for why.
+    """
+
+    if layout == "row" and _resolve_fused(fused):
+        from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
+
+        return make_fused_tick_fn(capacity)
+
+    rows_fn = make_tick32_rows_fn(capacity, layout)
+
+    def tick(state, m32, now):
+        state, rows = rows_fn(state, m32, now)
+        return state, jnp.stack(rows)
 
     return tick
 
 
 @functools.lru_cache(maxsize=None)
+def _jitted_stack6():
+    return jax.jit(lambda rows: jnp.stack(rows))
+
+
+@functools.lru_cache(maxsize=None)
 def jitted_tick32(capacity: int, layout: str = "columns",
                   fused: bool | None = None):
-    return jax.jit(
-        make_tick32_fn(capacity, layout, fused=fused), donate_argnums=(0,))
+    """Engine entry: two-program composition (tick rows + stack) so the
+    CPU backend never sees a concatenate-rooted mega-fusion (see
+    make_tick32_rows_fn).  The fused Pallas row kernel packs its response
+    in-kernel and stays a single program."""
+    if layout == "row" and _resolve_fused(fused):
+        from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
+
+        return jax.jit(make_fused_tick_fn(capacity), donate_argnums=(0,))
+
+    inner = jax.jit(
+        make_tick32_rows_fn(capacity, layout), donate_argnums=(0,))
+    stack = _jitted_stack6()
+
+    def tick(state, m32, now):
+        state, rows = inner(state, m32, now)
+        return state, stack(rows)
+
+    return tick

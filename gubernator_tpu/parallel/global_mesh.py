@@ -421,6 +421,9 @@ class MeshGlobalEngine:
         min_reconcile_ms: int = 0,
         strict_sequencing: bool = True,
     ):
+        from gubernator_tpu.config import validate_global_mesh_capacity
+
+        validate_global_mesh_capacity(int(capacity))
         self.mesh = mesh if mesh is not None else make_global_mesh()
         self.n_nodes = self.mesh.devices.size
         # Capacity must split evenly into per-node authority slices.

@@ -366,7 +366,9 @@ class V1Instance:
             self.metrics.getratelimit_counter.labels(calltype="local").inc(
                 len(cols) - len(errors)
             )
-            over = int(mat[4].sum())
+            from gubernator_tpu.ops.engine import masked_over_limit
+
+            over = masked_over_limit(mat, errors)
             if over:
                 self.metrics.over_limit_counter.inc(over)
             return mat, errors

@@ -321,6 +321,26 @@ class TickLoop:
             m.unexpired_evictions.inc(unexp - self._synced_unexpired)
             self._synced_unexpired = unexp
 
+    def _drain_resolve_q(self, err: Exception) -> None:
+        """Fail every window still queued for resolution.  A drained None
+        stop sentinel is re-enqueued: a resolver that was merely slow (not
+        dead) must still find it when it loops back to get(), or it would
+        block on the empty queue forever."""
+        saw_sentinel = False
+        while True:
+            try:
+                item = self._resolve_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                saw_sentinel = True
+                continue
+            subs, _ = item
+            for _, _, items, _ in subs:
+                _fail_waiters(items, err)
+        if saw_sentinel:
+            self._resolve_q.put(None)
+
     def close(self) -> None:
         with self._cond:
             self._running = False
@@ -337,15 +357,13 @@ class TickLoop:
                 self._pending_count = 0
             err = RuntimeError("tick loop shut down with requests pending")
             _fail_waiters([(n, fut) for _, _, n, fut in stuck], err)
-            while True:
-                try:
-                    item = self._resolve_q.get_nowait()
-                except queue.Empty:
-                    break
-                if item is None:
-                    continue
-                subs, _ = item
-                for _, _, items, _ in subs:
-                    _fail_waiters(items, err)
+            self._drain_resolve_q(err)
             return
         self._resolver.join(timeout=5)
+        if self._resolver.is_alive():
+            # Resolver wedged (e.g. a D2H that never completes): windows
+            # already submitted for resolution would leave their callers
+            # awaiting wrap_future forever — fail whatever is still queued.
+            self._drain_resolve_q(
+                RuntimeError("tick loop shut down with requests pending")
+            )

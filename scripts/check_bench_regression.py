@@ -54,21 +54,26 @@ def load_bench(path):
 
 
 def rates(doc):
-    """rung → (rate, shape_key).  The shape key carries the workload
-    parameters (key count, batch width) so a BENCH_FAST candidate is
-    never gated against a full-size baseline under the same rung name —
-    mismatched shapes are reported, not judged (the reference gate
-    compares like-for-like PR-vs-master runs on one runner)."""
+    """rung → (rate, shape_key, spread).  The shape key carries the
+    workload parameters (key count, batch width) so a BENCH_FAST
+    candidate is never gated against a full-size baseline under the same
+    rung name — mismatched shapes are reported, not judged (the reference
+    gate compares like-for-like PR-vs-master runs on one runner).  The
+    spread is the rung's recorded sample dispersion ((max-min)/max of its
+    median-of-k samples, bench.diff_time); the gate widens its threshold
+    by both files' spreads so a noisy-but-honest rung doesn't flap."""
     out = {}
     if doc.get("value") is not None:
-        out["headline"] = (float(doc["value"]), ())
+        out["headline"] = (float(doc["value"]), (), 0.0)
     for rung in doc.get("ladder", []):
         shape = tuple(
             (k, rung[k]) for k in ("keys", "batch", "nodes") if k in rung
         )
         for k in RATE_KEYS:
             if rung.get(k):
-                out[rung["rung"]] = (float(rung[k]), shape)
+                out[rung["rung"]] = (
+                    float(rung[k]), shape, float(rung.get("spread") or 0.0)
+                )
                 break
     return out
 
@@ -79,33 +84,47 @@ def main():
     ap.add_argument("candidate")
     ap.add_argument("--threshold", type=float, default=2.0,
                     help="fail when baseline/candidate exceeds this")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="don't fail when no rung was actually gated "
+                         "(manual cross-shape comparisons)")
     args = ap.parse_args()
 
     base = rates(load_bench(args.baseline))
     cand = rates(load_bench(args.candidate))
 
     failed = False
+    gated = 0
     for name in sorted(set(base) | set(cand)):
         bs, cs = base.get(name), cand.get(name)
         if bs is None or cs is None:
             print(f"  {name}: only in "
                   f"{'candidate' if bs is None else 'baseline'} — not gated")
             continue
-        (b, b_shape), (c, c_shape) = bs, cs
+        (b, b_shape, b_spread), (c, c_shape, c_spread) = bs, cs
         if b_shape != c_shape:
             print(f"  {name}: workload shape differs "
                   f"({dict(b_shape)} vs {dict(c_shape)}) — not gated")
             continue
+        gated += 1
         if c <= 0:
             print(f"  {name}: candidate rate is 0 — FAIL")
             failed = True
             continue
+        # Spread-aware slack: a rung whose own samples disperse by s can
+        # legitimately move by (1+s) run-to-run; both runs contribute.
+        allowed = args.threshold * (1 + b_spread) * (1 + c_spread)
         slowdown = b / c
-        mark = "FAIL" if slowdown > args.threshold else "ok"
-        if slowdown > args.threshold:
+        mark = "FAIL" if slowdown > allowed else "ok"
+        if slowdown > allowed:
             failed = True
         print(f"  {name}: {b:,.0f} -> {c:,.0f} "
-              f"({1 / slowdown:.2f}x, {mark})")
+              f"({1 / slowdown:.2f}x, allowed {1 / allowed:.2f}x, {mark})")
+    if gated == 0 and not args.allow_empty:
+        # A gate that judged nothing must not report success (the CI job
+        # would pass vacuously whenever shapes diverge — advisor r3).
+        print("no rungs were gated (all skipped/mismatched) — FAIL; "
+              "regenerate the like-for-like baseline or pass --allow-empty")
+        failed = True
     sys.exit(1 if failed else 0)
 
 

@@ -1,0 +1,127 @@
+"""Probe: does shrinking the row width (512 B -> 256/128 B) raise the
+random row-gather rate?  If the DMA engine is descriptor-rate-bound the
+curve is flat; if byte-bound, narrower rows should approach 2x/4x.
+
+Also probes a combined read+write kernel (one descriptor pair per row,
+interleaved) at each width — the fused tick's true floor.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+CAP = 1 << 20
+B = 1 << 15
+N = int(__import__('os').environ.get('PROBE_N', '100'))
+RING = 32
+
+_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def make_gather(row_w, rw=False):
+    def kernel(slots_ref, table_ref, out_ref, sem, wsem=None):
+        def start(j):
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(slots_ref[j], 1), :],
+                out_ref.at[pl.ds(j, 1), :],
+                sem.at[lax.rem(j, RING)],
+            )
+
+        def wstart(j):
+            return pltpu.make_async_copy(
+                out_ref.at[pl.ds(j, 1), :],
+                table_ref.at[pl.ds(slots_ref[j], 1), :],
+                wsem.at[lax.rem(j, RING)],
+            )
+
+        def body(j, _):
+            @pl.when(j >= RING)
+            def _():
+                start(j - RING).wait()
+                if rw:
+                    wstart(j - RING).wait()
+
+            start(j).start()
+            if rw:
+                wstart(j).start()
+            return 0
+
+        lax.fori_loop(0, B, body, 0)
+
+        def drain(j, _):
+            start(j).wait()
+            if rw:
+                wstart(j).wait()
+            return 0
+
+        lax.fori_loop(B - RING, B, drain, 0)
+
+    return kernel
+
+
+def run_width(row_w, rw):
+    print(f"compiling row_w={row_w} rw={rw}", flush=True)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.integers(0, 100, (CAP + 1, row_w), np.int32))
+    slots = jnp.asarray(np.sort(rng.permutation(CAP)[:B]).astype(np.int32))
+    kernel = make_gather(row_w, rw)
+    sems = [pltpu.SemaphoreType.DMA((RING,))]
+    if rw:
+        sems.append(pltpu.SemaphoreType.DMA((RING,)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=sems,
+    )
+
+    def op(table):
+        with jax.enable_x64(False):
+            return pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((B, row_w), jnp.int32),
+                compiler_params=_PARAMS,
+                interpret=False,
+                input_output_aliases={},
+            )(slots, table)
+
+    def chain(iters):
+        @jax.jit
+        def run(table=table):
+            def body(i, carry):
+                return op(table)
+
+            return lax.fori_loop(0, iters, body, op(table))
+
+        return run
+
+    runs = {k: chain(k) for k in (N, 2 * N)}
+    for r in runs.values():
+        np.asarray(r()[:1, :1])
+
+    def timed(r):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(r()[:1, :1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    per = (timed(runs[2 * N]) - timed(runs[N])) / N
+    tag = "rd+wr" if rw else "rd   "
+    print(f"{tag} row_w={row_w:4d} ({row_w*4:4d} B)  "
+          f"{per*1e6:8.1f} us  ({B/per/1e6:7.1f} M rows/s)", flush=True)
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    for rw in (False, True):
+        for row_w in ([128, 32] if not rw else [128]):
+            run_width(row_w, rw)

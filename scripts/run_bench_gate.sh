@@ -8,7 +8,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Gate like-for-like: the committed fast-mode CPU baseline matches the
+# candidate's BENCH_FAST workload shapes, so rungs actually gate instead
+# of skipping on shape mismatch (advisor r3: a full-size BENCH_r*.json
+# baseline made the gate pass vacuously).  Regenerate it after intended
+# perf changes with:
+#   JAX_PLATFORMS=cpu BENCH_FAST=1 python bench.py | tail -1 > BENCH_FAST_BASELINE.json
 baseline="${1:-}"
+if [ -z "$baseline" ] && [ -f BENCH_FAST_BASELINE.json ]; then
+    baseline=BENCH_FAST_BASELINE.json
+fi
 if [ -z "$baseline" ]; then
     baseline=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1 || true)
 fi

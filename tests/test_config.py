@@ -119,3 +119,42 @@ def test_bg_reclaim_knob(monkeypatch):
     monkeypatch.setenv("GUBER_TPU_BG_RECLAIM", "sometimes")
     with pytest.raises(ValueError, match="GUBER_TPU_BG_RECLAIM"):
         setup_daemon_config()
+
+
+def test_global_mesh_capacity_guard(caplog):
+    """Verdict r3 #9: the dense GLOBAL reconcile is O(capacity * nodes)
+    per sync interval (global_mesh.py scaling envelope) — the config
+    surface warns past the 2^20 soft bound and refuses past 2^24."""
+    import logging
+
+    from gubernator_tpu.config import (
+        GLOBAL_MESH_CAPACITY_HARD,
+        GLOBAL_MESH_CAPACITY_SOFT,
+    )
+
+    # in-envelope: silent
+    with caplog.at_level(logging.WARNING, logger="gubernator"):
+        conf_from({"GUBER_TPU_GLOBAL_MESH_CAPACITY": str(1 << 16)})
+    assert "GLOBAL_MESH_CAPACITY" not in caplog.text
+
+    # past the soft bound: warns, still accepted
+    with caplog.at_level(logging.WARNING, logger="gubernator"):
+        c = conf_from({
+            "GUBER_TPU_GLOBAL_MESH_CAPACITY":
+                str(GLOBAL_MESH_CAPACITY_SOFT * 2),
+        })
+    assert c.config.tpu_global_mesh_capacity == GLOBAL_MESH_CAPACITY_SOFT * 2
+    assert "GLOBAL_MESH_CAPACITY" in caplog.text
+
+    # past the hard bound: refused
+    with pytest.raises(ValueError, match="GLOBAL_MESH_CAPACITY"):
+        conf_from({
+            "GUBER_TPU_GLOBAL_MESH_CAPACITY":
+                str(GLOBAL_MESH_CAPACITY_HARD * 2),
+        })
+
+    # the engine constructor enforces the same bound (programmatic use)
+    from gubernator_tpu.parallel.global_mesh import MeshGlobalEngine
+
+    with pytest.raises(ValueError, match="GLOBAL_MESH_CAPACITY"):
+        MeshGlobalEngine(capacity=GLOBAL_MESH_CAPACITY_HARD * 2)

@@ -12,11 +12,26 @@ import jax.numpy as jnp
 from gubernator_tpu.ops.engine import (
     REQ32_INDEX, REQ32_ROWS, _jitted_tick, pack_request_matrix32)
 from gubernator_tpu.ops.rowtable import RowState
-from gubernator_tpu.ops.tick32 import make_tick32_fn
+from gubernator_tpu.ops.tick32 import make_tick32_fn, make_tick32_rows_fn
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
 
 NOW = 1_700_000_000_000
 CAP = 2048
+
+
+def make_plain(cap):
+    """Unfused oracle via the two-program split: stacking the response
+    inside the jit hands XLA:CPU a concatenate-rooted fusion it executes
+    as a per-element tree walk (minutes per test — see
+    ops/tick32.make_tick32_rows_fn); the eager stack is its own tiny
+    program."""
+    inner = jax.jit(make_tick32_rows_fn(cap, "row"))
+
+    def f(state, m, now):
+        s, rows = inner(state, m, now)
+        return s, jnp.stack(rows)
+
+    return f
 
 
 def build_batch(rng, b, n, with_behaviors=True):
@@ -65,7 +80,7 @@ def test_fused_matches_unfused(seed, b):
 
     rng = np.random.default_rng(seed)
     fused = jax.jit(make_fused_tick_fn(CAP, chunk=32))
-    plain = jax.jit(make_tick32_fn(CAP, "row", fused=False))
+    plain = make_plain(CAP)
 
     state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
     state0 = populate(rng, plain, state0, b)
@@ -95,7 +110,7 @@ def test_fused_matches_merge_program_on_unique():
                           compact_resp=True, compact_req=True)
 
     state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
-    plain = jax.jit(make_tick32_fn(CAP, "row", fused=False))
+    plain = make_plain(CAP)
     state0 = populate(rng, plain, state0, b)
 
     m = build_batch(rng, b, 100)
@@ -117,7 +132,7 @@ def test_fused_single_chunk_width():
     rng = np.random.default_rng(9)
     b = 128
     fused = jax.jit(make_tick32_fn(CAP, "row", fused=True))
-    plain = jax.jit(make_tick32_fn(CAP, "row", fused=False))
+    plain = make_plain(CAP)
     state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
     m = build_batch(rng, b, 100)
     now = jnp.int64(NOW)

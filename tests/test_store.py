@@ -196,3 +196,53 @@ def test_load_columns_drops_expired_and_dedups(tmp_path):
     assert eng.cache_size() == 1
     out = eng.process([req(key="live", hits=0, limit=10)], now=NOW)[0]
     assert out.remaining == 3  # the LAST duplicate's remaining
+
+
+@pytest.mark.parametrize("layout", ["columns", "row"])
+def test_slim_export_probe_regimes(monkeypatch, layout):
+    """The schema-specialized export (engine.export_columns) drops hi
+    words a device probe proves redundant; this exercises all three
+    per-chunk regimes — hi == sign extension (small values), hi constant
+    (epoch-ms columns), hi varying (must transfer) — plus negative
+    remainings, the leaky f64 triple, and the multi-chunk path."""
+    import numpy as np
+
+    from gubernator_tpu.ops import engine as E
+
+    monkeypatch.setattr(E, "SNAP_CHUNK", 16)  # force several chunks
+    eng = E.TickEngine(capacity=256, max_batch=64, table_layout=layout)
+    reqs = []
+    for i in range(40):
+        reqs.append(req(key=f"big{i}", hits=3, limit=(1 << 34) + i,
+                        duration=60_000))
+    # negative remaining: hits overdraft via DRAIN_OVER_LIMIT
+    from gubernator_tpu.types import Behavior
+
+    reqs.append(req(key="drained", hits=9, limit=5,
+                    behavior=Behavior.DRAIN_OVER_LIMIT))
+    reqs.append(req(key="leaky", hits=3, limit=7, algorithm=1))
+    eng.process(reqs, now=NOW)
+
+    snap = eng.export_columns()
+    stats = eng.last_export_stats
+    assert stats["items"] == 42
+    # limits straddle 2^34 (hi word needed) but the epoch-ms columns'
+    # hi is constant and the remaining column is sign-extended — the
+    # transfer must be well under the full 80 B/slot schema.
+    assert 0 < stats["d2h_bytes"] < 42 * 80
+    by_key = {it["key"]: it for it in E.items_from_snapshot(snap)}
+    # The per-item dict export is the oracle: every field of every item
+    # must survive the probe/selection/decoding path bit-for-bit.
+    oracle = {it["key"]: it for it in eng.export_items()}
+    assert set(by_key) == set(oracle)
+    for k, it in oracle.items():
+        for f, v in it.items():
+            assert by_key[k][f] == v, (k, f, by_key[k][f], v)
+    assert by_key["store_test_big7"]["limit"] == (1 << 34) + 7
+    assert by_key["store_test_big7"]["remaining"] == (1 << 34) + 7 - 3
+
+    eng2 = E.TickEngine(capacity=256, max_batch=64, table_layout=layout)
+    eng2.load_columns(snap, now=NOW + 1)
+    out = eng2.process([req(key="big7", hits=0, limit=(1 << 34) + 7)],
+                       now=NOW + 1)[0]
+    assert out.remaining == (1 << 34) + 7 - 3
