@@ -428,6 +428,90 @@ def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
     return out
 
 
+def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int):
+    """Host-side grouped-tick plan for a slot-sorted compact batch (the
+    BASELINE north star's hot-key scatter-add): duplicate groups collapse
+    to one device row each when every follower is identical to its head,
+    known, hits > 0, and free of RESET_REMAINING / Gregorian behaviors —
+    the same eligibility the device-side fold uses
+    (:func:`_apply_merged_followers` ``ok``).  Returns
+    ``(mhead (19, Upad), count (Upad,), uidx (B,), rank (B,))`` or None
+    when any group is ineligible (those batches keep the sequential
+    rank-round program, whose per-unit rounds handle mixed groups).
+
+    ``uidx``/``rank`` address the expansion program
+    (transition32.expand32_rows): member i's response derives from head column
+    ``uidx[i]`` at rank ``rank[i]``; lanes past ``n`` and error lanes
+    point at a padding head column and stay unspecified, like the plain
+    tick's padding lanes."""
+    R = REQ32_INDEX
+    b = m.shape[1]
+    s = m[R["slot"], :n]
+    live = s < capacity
+    if n == 0 or not live.any():
+        return None
+    is_start = np.empty(n, bool)
+    is_start[0] = True
+    np.not_equal(s[1:], s[:-1], out=is_start[1:])
+    starts = np.flatnonzero(is_start)
+    if len(starts) == n:
+        return None  # no duplicates — the plain unique program is cheaper
+    gid = np.cumsum(is_start) - 1
+    rank = np.arange(n, dtype=np.int32) - starts[gid].astype(np.int32)
+
+    PARAM_ROWS = (
+        R["algorithm"], R["behavior"],
+        R["hits"], R["hits"] + 1,
+        R["limit"], R["limit"] + 1,
+        R["duration"], R["duration"] + 1,
+        R["created_at"], R["created_at"] + 1,
+        R["burst"], R["burst"] + 1,
+        R["greg_exp"], R["greg_exp"] + 1,
+        R["greg_dur"], R["greg_dur"] + 1,
+    )
+    eq_prev = np.ones(n, bool)
+    for r in PARAM_ROWS:
+        eq_prev[1:] &= m[r, 1:n] == m[r, : n - 1]
+    hits_pos = join_i32_pair(m[R["hits"], :n], m[R["hits"] + 1, :n]) > 0
+    known = m[R["known"], :n] != 0
+    no_merge = int(Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN)
+    beh_ok = (m[R["behavior"], :n] & no_merge) == 0
+    # The fold requires the head row to come out ALIVE (post-transition
+    # expire_at >= now) — a dead head sends the x64 path's followers to
+    # fresh-install rank rounds, which the closed form cannot express.
+    # duration > 0 plus created_at >= now guarantees it for every
+    # reachable head branch (new: expire = created+duration > now;
+    # exists: expire_cand > created >= now); groups that fail (negative
+    # durations, client-backdated duplicates) keep the sequential
+    # program.
+    dur = join_i32_pair(m[R["duration"], :n], m[R["duration"] + 1, :n])
+    created = join_i32_pair(
+        m[R["created_at"], :n], m[R["created_at"] + 1, :n])
+    alive_ok = (dur > 0) & (created >= now)
+    follower = ~is_start & live
+    if np.any(follower & ~(eq_prev & known & hits_pos & beh_ok & alive_ok)):
+        return None
+
+    u = len(starts)
+    # Quantize the head width hard (floor at max(256, b/4)): every
+    # distinct (Upad, B) pair compiles its own merged-tick + expansion
+    # program, and a serving engine must not accumulate one compile per
+    # traffic shape (tunnel/TPU compiles run tens of seconds).
+    upad = pad_pow2(max(u, 256, b // 4))
+    mhead = np.empty((REQ32_ROWS, upad), np.int32)
+    mhead[:, :u] = m[:, starts]
+    mhead[:, u:] = 0
+    mhead[R["slot"], u:] = capacity  # padding heads aim at the guard row
+    count = np.ones(upad, np.int32)
+    sizes = np.diff(np.append(starts, n)).astype(np.int32)
+    count[:u] = sizes
+    uidx = np.full(b, upad - 1, np.int32)
+    uidx[:n] = gid
+    rank_b = np.zeros(b, np.int32)
+    rank_b[:n] = rank
+    return mhead, count, uidx, rank_b
+
+
 def masked_over_limit(resp_mat: np.ndarray, errors) -> int:
     """Over-limit count from a public (5, n) response matrix with the
     per-item-error lanes zeroed first — their values are unspecified in
@@ -1685,9 +1769,18 @@ class TickEngine:
         # Unique-slot batches (no duplicate keys after the host sort) run
         # the parts-native program: pure int32/f32, no XLA 64-bit
         # emulation, Pallas-fusable (ops/tick32.py).
-        from gubernator_tpu.ops.tick32 import jitted_tick32
+        from gubernator_tpu.ops.tick32 import (
+            jitted_merged_pipeline,
+            jitted_tick32,
+        )
 
         self._tick32 = jitted_tick32(self.capacity, self.layout)
+        # Grouped batches (uniform duplicate groups — Zipf/hot-key
+        # traffic) tick each unique head once with a closed-form follower
+        # fold, then expand per-member responses elementwise: the
+        # scatter-add architecture from BASELINE.json.  Compiles lazily
+        # on the first grouped batch (warmup compiles stay bounded).
+        self._tick32m = jitted_merged_pipeline(self.capacity, self.layout)
         # Tick widths: one narrow program for typical service batches
         # (≤ the reference's 1000-item batch limit) plus the full width.
         # Singleton for small engines so test clusters don't pay an extra
@@ -2104,11 +2197,32 @@ class TickEngine:
             packed, n, errors, inv, has_dups = self._build_cols(cols, now)
             # Named range in XProf captures (utils/tracing.py): device
             # tick vs host packing shows up separated in the profile.
+            plan = (
+                build_group_plan(packed, n, self.capacity, now)
+                if has_dups else None
+            )
             with tracing.profile_annotation("guber.tick"):
-                tick = self._tick if has_dups else self._tick32
-                self.state, resp = tick(
-                    self.state, jnp.asarray(packed), jnp.int64(now)
-                )
+                if plan is not None:
+                    # Grouped tick: unique heads through the parts
+                    # program (fold on device), member responses from
+                    # the elementwise expansion — a k-deep hot key costs
+                    # one row of HBM traffic, not k.
+                    mhead, count, uidx, rank = plan
+                    self.state, resp = self._tick32m(
+                        self.state, jnp.asarray(mhead),
+                        jnp.asarray(count), jnp.asarray(uidx),
+                        jnp.asarray(rank), jnp.int64(now),
+                    )
+                elif has_dups:
+                    # Mixed/ineligible groups: the sequential rank-round
+                    # program (unit-merge) preserves cross-member order.
+                    self.state, resp = self._tick(
+                        self.state, jnp.asarray(packed), jnp.int64(now)
+                    )
+                else:
+                    self.state, resp = self._tick32(
+                        self.state, jnp.asarray(packed), jnp.int64(now)
+                    )
             self._pending.clear()
             slots_req = (
                 packed[REQ32_INDEX["slot"], :n][inv].astype(np.int64)

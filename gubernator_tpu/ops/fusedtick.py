@@ -233,7 +233,7 @@ def make_fused_tick_fn(capacity: int, chunk: int | None = None):
 
 
 def _kernel(slots_ref, now_ref, m32_ref, table_ref, tout_ref, resp_ref,
-            rbuf, wbuf, rsem, wsem, *, capacity, C, nc):
+            rbuf, wbuf, rsem, wsem, *, capacity, C, nc, merged=False):
     cap_i = jnp.int32(capacity)
 
     # The scalar core's DMA work is the kernel's second wall (~23 ns per
@@ -291,26 +291,40 @@ def _kernel(slots_ref, now_ref, m32_ref, table_ref, tout_ref, resp_ref,
         base = c * C
         T = _transpose_fwd(rbuf[buf, :, :TW])
         s = _pstate_from_T(T)
-        mr = m32_ref[:, pl.ds(base, C)]
+        mr = m32_ref[:REQ32_ROWS, pl.ds(base, C)]
         r = _preq_from_rows(mr)
         now_pair = I64(
             jnp.full((1, C), now_ref[0], I32),
             jnp.full((1, C), now_ref[1], I32),
         )
         new_state, resp = transition32(now_pair, s, r)
-        out = _transpose_bwd(_pstate_to_T(new_state))  # (C, TW)
-        wbuf[buf, :, :TW] = out
-        resp_ref[:, pl.ds(base, C)] = jnp.concatenate(
-            [
+        if merged:
+            from gubernator_tpu.ops.transition32 import (
+                MERGED24_ROWS,
+                merged24_rows,
+                merged_fold32,
+            )
+
+            cnt = m32_ref[REQ32_ROWS:REQ32_ROWS + 1, pl.ds(base, C)]
+            new_state, head = merged_fold32(now_pair, new_state, r, cnt)
+            rows = list(merged24_rows(resp, head, r))
+            rows += [jnp.zeros((1, C), I32)] * (MERGED24_ROWS - len(rows))
+            # Row-major output via the same exact one-hot MXU transpose
+            # the table rows use (TW == MERGED24_ROWS == 24).
+            respT = _transpose_bwd(jnp.concatenate(rows, axis=0))
+            resp_ref[pl.ds(base, C), :] = respT
+        else:
+            rows = [
                 resp.status,
                 resp.over_limit.astype(I32),
                 resp.remaining.lo,
                 resp.remaining.hi,
                 resp.reset_time.lo,
                 resp.reset_time.hi,
-            ],
-            axis=0,
-        )
+            ]
+            resp_ref[:, pl.ds(base, C)] = jnp.concatenate(rows, axis=0)
+        out = _transpose_bwd(_pstate_to_T(new_state))  # (C, TW)
+        wbuf[buf, :, :TW] = out
 
     # Spare words of the write rows are zero for the whole kernel (rows
     # scatter whole-width; eviction/installs expect zeroed spares).
@@ -354,3 +368,68 @@ def _kernel(slots_ref, now_ref, m32_ref, table_ref, tout_ref, resp_ref,
     lax.fori_loop(0, nc // 2, pair_body, 0)
     wait_writes(nc - 2, 0)
     wait_writes(nc - 1, 1)
+
+
+def make_fused_merged_tick_fn(capacity: int, chunk: int | None = None):
+    """Grouped variant of the fused tick: same DMA pipeline, with the
+    closed-form duplicate fold (transition32.merged_fold32) applied
+    in-register before the scatter.  ``count`` rides as a 20th
+    request-matrix row so the kernel reads it from VMEM like any other
+    request field.
+
+    Output format is ROW-MAJOR ``(U, 24)`` (transition32.MERGED24 row
+    order: compact resp + MergedHead extras + the request params the
+    expansion needs) — the per-member expansion gathers whole 96 B rows
+    by head index, which the TPU executes ~40x faster than 15 separate
+    lane-dimension gathers (chained-differential probe: 95 µs vs 3.6 ms
+    for 32K members).  The transpose into row-major rides the same
+    one-hot MXU blocks as the table rows."""
+    from gubernator_tpu.ops.transition32 import MERGED24_ROWS
+
+    def tick(state, mhead, count, now):
+        b = mhead.shape[1]
+        c = min(chunk or 2048, b)
+        nc = b // c
+        assert b % c == 0 and (nc == 1 or nc % 2 == 0), (b, c)
+        slots = mhead[REQ32_INDEX["slot"]]
+        from gubernator_tpu.ops.tick32 import now_to_pair
+
+        np_ = now_to_pair(now)
+        now2 = jnp.stack([np_.lo, np_.hi])
+        m20 = jnp.concatenate([mhead, count[None].astype(I32)], axis=0)
+
+        kernel = functools.partial(
+            _kernel, capacity=capacity, C=c, nc=nc, merged=True)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # slots, now2
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((REQ32_ROWS + 1, b), lambda t, *_: (0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # table (HBM)
+            ],
+            out_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),  # table out (aliased)
+                pl.BlockSpec((b, MERGED24_ROWS), lambda t, *_: (0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, c, ROW_W), I32),
+                pltpu.VMEM((2, c, ROW_W), I32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        )
+        with jax.enable_x64(False):
+            table, resp = pl.pallas_call(
+                kernel,
+                grid_spec=grid_spec,
+                out_shape=[
+                    jax.ShapeDtypeStruct((capacity + 1, ROW_W), I32),
+                    jax.ShapeDtypeStruct((b, MERGED24_ROWS), I32),
+                ],
+                input_output_aliases={3: 0},
+                compiler_params=_VMEM,
+                interpret=_interpret(),
+            )(slots, now2, m20, state.table)
+        return state._replace(table=table), resp
+
+    return tick

@@ -179,3 +179,25 @@ def from_np(v):
     lo = (v & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
     hi = (v >> 32).astype(np.int32)
     return I64(jnp.asarray(lo), jnp.asarray(hi))
+
+
+def div_floor_pos(a: I64, b: I64) -> I64:
+    """``a // b`` for ``a >= 0, b > 0`` (the group-fold quotient: rate
+    limits' remaining and hits are non-negative by the time a fold runs).
+
+    No 64-bit divide exists on TPU, so the candidate quotient comes from
+    triple-f32 division (~70-bit, ops/tfloat.py) and is then corrected in
+    exact pair arithmetic: the remainder ``a - q*b`` decides ±1 steps.
+    Two correction rounds cover the triple's worst-case rounding (the
+    candidate is within one of the true quotient; a second round guards
+    the floor-vs-compare edge at exact multiples).  Differentially tested
+    against the x64 oracle in tests/test_parts_math.py."""
+    from gubernator_tpu.ops import tfloat as tf
+
+    q = tf.floor_to_pair(tf.div(tf.from_pair(a), tf.from_pair(b)))
+    q = select(is_neg(q), I64(jnp.zeros_like(q.lo), jnp.zeros_like(q.hi)), q)
+    for _ in range(2):
+        r = sub(a, mul(q, b))
+        q = select(is_neg(r), sub(q, from_i32(jnp.ones_like(q.lo))), q)
+        q = select(ge(r, b), add(q, from_i32(jnp.ones_like(q.lo))), q)
+    return q

@@ -182,3 +182,98 @@ def jitted_tick32(capacity: int, layout: str = "columns",
         return state, stack(rows)
 
     return tick
+
+
+# ----------------------------------------------------------------------
+# Grouped ("scatter-add") tick: unique heads + closed-form fold
+# ----------------------------------------------------------------------
+def make_merged_tick32_rows_fn(capacity: int, layout: str = "columns"):
+    """(state, mhead (19, U) i32, count (U,) i32, now) → (state, 15-row
+    tuple): the unique-head tick with the duplicate-group fold applied to
+    the table row (transition32.merged_fold32) and the head extras the
+    expansion program needs.  Same unstacked-rows discipline as
+    make_tick32_rows_fn (XLA:CPU concat-fusion pathology)."""
+    from gubernator_tpu.ops.transition32 import merged_fold32
+
+    def rows_of(now, s, r, count, new_g, resp):
+        folded, head = merged_fold32(now, new_g, r, count)
+        return folded, (
+            resp.status,
+            resp.over_limit.astype(I32),
+            resp.remaining.lo, resp.remaining.hi,
+            resp.reset_time.lo, resp.reset_time.hi,
+            head.base.lo, head.base.hi,
+            head.q.lo, head.q.hi,
+            head.rate_i.lo, head.rate_i.hi,
+            head.s0,
+            head.expire.lo, head.expire.hi,
+        )
+
+    if layout == "row":
+        from gubernator_tpu.ops.rowtable import gather_rows, scatter_rows
+
+        def tick(state, mhead, count, now):
+            r = preq_from_compact(mhead)
+            slots = jnp.clip(r.slot, 0, capacity)
+            mat = gather_rows(state.table, slots)
+            s = pstate_from_matrix(mat)
+            np_ = now_to_pair(now)
+            new_g, resp = transition32(np_, s, r)
+            folded, rows = rows_of(np_, s, r, count, new_g, resp)
+            scat = jnp.where(r.valid, slots, jnp.int32(capacity))
+            table = scatter_rows(
+                state.table, scat, pstate_to_matrix(folded))
+            return state._replace(table=table), rows
+
+    else:
+
+        def tick(state, mhead, count, now):
+            r = preq_from_compact(mhead)
+            slots = jnp.clip(r.slot, 0, capacity - 1)
+            s = pstate_gather_columns(state, slots)
+            np_ = now_to_pair(now)
+            new_g, resp = transition32(np_, s, r)
+            folded, rows = rows_of(np_, s, r, count, new_g, resp)
+            scat = jnp.where(r.valid, r.slot, jnp.int32(capacity))
+            state = pstate_scatter_columns(state, scat, folded)
+            return state, rows
+
+    return tick
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_merged_pipeline(capacity: int, layout: str = "columns",
+                           fused: bool | None = None):
+    """Engine entry for grouped batches: (state, mhead, count, uidx,
+    rank, now) → (state, (6, B) compact responses).  Composes the merged
+    tick with the member expansion, hiding the format split: the fused
+    Pallas kernel emits the row-major (U, 24) block (one whole-row
+    gather per member — the TPU-fast layout), the XLA fallback emits
+    unstacked rows (the CPU-safe layout)."""
+    if layout == "row" and _resolve_fused(fused):
+        from gubernator_tpu.ops.fusedtick import make_fused_merged_tick_fn
+        from gubernator_tpu.ops.transition32 import expand32_rowmajor
+
+        tick = jax.jit(
+            make_fused_merged_tick_fn(capacity), donate_argnums=(0,))
+        expand = jax.jit(lambda r24, uidx, rank: jnp.stack(
+            expand32_rowmajor(r24, uidx, rank)))
+
+        def run(state, mhead, count, uidx, rank, now):
+            state, r24 = tick(state, mhead, count, now)
+            return state, expand(r24, uidx, rank)
+
+        return run
+
+    from gubernator_tpu.ops.transition32 import expand32_rows
+
+    inner = jax.jit(
+        make_merged_tick32_rows_fn(capacity, layout), donate_argnums=(0,))
+    expand = jax.jit(expand32_rows)
+    stack = _jitted_stack6()
+
+    def run(state, mhead, count, uidx, rank, now):
+        state, rows = inner(state, mhead, count, now)
+        return state, stack(expand(tuple(rows), mhead, uidx, rank))
+
+    return run

@@ -151,10 +151,22 @@ class V1Instance:
         self.log = log
         self.metrics = conf.metrics or Metrics()
         self.engine = engine if engine is not None else _make_engine(conf)
+        # The window fills to the DEVICE program width by default, not
+        # the peer-protocol BatchLimit: the device tick amortizes best
+        # when several callers' batches coalesce into one program
+        # invocation (the reference's worker pool has no analogous cap —
+        # it drains whatever queued, workers.go:125-147).  An operator
+        # who explicitly tunes GUBER_BATCH_LIMIT away from the
+        # reference default still caps the window with it.
+        window_limit = (
+            conf.behaviors.batch_limit
+            if conf.behaviors.batch_limit != 1000
+            else conf.tpu_max_batch
+        )
         self.tick_loop = TickLoop(
             self.engine,
             batch_wait=conf.behaviors.batch_wait,
-            batch_limit=conf.behaviors.batch_limit,
+            batch_limit=window_limit,
             metrics=self.metrics,
         )
         hash_fn = HASH_FUNCTIONS[conf.picker_hash]

