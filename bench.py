@@ -259,10 +259,10 @@ def rung_kernel_zipf():
                        for p in plans])), 1)
 
     def repad(p):
-        mhead, count, uidx, rank = p
+        mhead, count, uidx, rank, _ = p
         u = mhead.shape[1]
         if u == upad:
-            return p
+            return mhead, count, uidx, rank
         mh = np.zeros((REQ32_ROWS, upad), np.int32)
         mh[:, :u] = mhead
         mh[R32["slot"], u:] = capacity

@@ -428,16 +428,23 @@ def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
     return out
 
 
-def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int):
+def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
+                     min_dup_frac: float = 1 / 8):
     """Host-side grouped-tick plan for a slot-sorted compact batch (the
     BASELINE north star's hot-key scatter-add): duplicate groups collapse
     to one device row each when every follower is identical to its head,
-    known, hits > 0, and free of RESET_REMAINING / Gregorian behaviors —
-    the same eligibility the device-side fold uses
-    (:func:`_apply_merged_followers` ``ok``).  Returns
-    ``(mhead (19, Upad), count (Upad,), uidx (B,), rank (B,))`` or None
-    when any group is ineligible (those batches keep the sequential
-    rank-round program, whose per-unit rounds handle mixed groups).
+    known, hits > 0, free of RESET_REMAINING / Gregorian behaviors, and
+    under a head that provably comes out alive — the same eligibility the
+    device-side fold uses (:func:`_apply_merged_followers` ``ok``).
+    Returns ``(mhead (19, Upad), count (Upad,), uidx (B,), rank (B,),
+    u)`` — ``u`` the live head count — or None when any group is
+    ineligible (those batches keep the sequential rank-round program,
+    whose per-unit rounds handle mixed groups) or when fewer than
+    ``min_dup_frac`` of the live rows are followers: a near-unique batch
+    saves almost no device rows while the grouped path's (U, 24) head
+    block costs ~4x the compact response's D2H bytes, so shallow
+    duplication stays on the sequential program.  The savings check runs
+    before the O(n·rows) eligibility sweep and the plan allocations.
 
     ``uidx``/``rank`` address the expansion program
     (transition32.expand32_rows): member i's response derives from head column
@@ -453,9 +460,13 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int):
     is_start = np.empty(n, bool)
     is_start[0] = True
     np.not_equal(s[1:], s[:-1], out=is_start[1:])
+    # Row savings count LIVE followers only: error/padding lanes share
+    # slot == capacity and would otherwise masquerade as one huge
+    # "duplicate group".
+    dup_rows = int(np.count_nonzero(~is_start & live))
+    if dup_rows < max(1, int(min_dup_frac * int(np.count_nonzero(live)))):
+        return None
     starts = np.flatnonzero(is_start)
-    if len(starts) == n:
-        return None  # no duplicates — the plain unique program is cheaper
     gid = np.cumsum(is_start) - 1
     rank = np.arange(n, dtype=np.int32) - starts[gid].astype(np.int32)
 
@@ -509,7 +520,7 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int):
     uidx[:n] = gid
     rank_b = np.zeros(b, np.int32)
     rank_b[:n] = rank
-    return mhead, count, uidx, rank_b
+    return mhead, count, uidx, rank_b, u
 
 
 def masked_over_limit(resp_mat: np.ndarray, errors) -> int:
@@ -2207,7 +2218,7 @@ class TickEngine:
                     # program (fold on device), member responses from
                     # the elementwise expansion — a k-deep hot key costs
                     # one row of HBM traffic, not k.
-                    mhead, count, uidx, rank = plan
+                    mhead, count, uidx, rank, _ = plan
                     self.state, resp = self._tick32m(
                         self.state, jnp.asarray(mhead),
                         jnp.asarray(count), jnp.asarray(uidx),
