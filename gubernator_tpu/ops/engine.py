@@ -428,6 +428,13 @@ def unpack_resp_compact(raw: np.ndarray, limit_req: np.ndarray) -> np.ndarray:
     return out
 
 
+def group_upad(b: int, u: int = 0) -> int:
+    """The grouped plan's quantized head width for a batch width ``b``:
+    hard floor at max(256, b/4) so serving traffic compiles a handful of
+    (Upad, B) merged/expansion programs, not one per traffic shape."""
+    return pad_pow2(max(u, 256, b // 4))
+
+
 def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
                      min_dup_frac: float = 1 / 8):
     """Host-side grouped-tick plan for a slot-sorted compact batch (the
@@ -504,11 +511,7 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
         return None
 
     u = len(starts)
-    # Quantize the head width hard (floor at max(256, b/4)): every
-    # distinct (Upad, B) pair compiles its own merged-tick + expansion
-    # program, and a serving engine must not accumulate one compile per
-    # traffic shape (tunnel/TPU compiles run tens of seconds).
-    upad = pad_pow2(max(u, 256, b // 4))
+    upad = group_upad(b, u)
     mhead = np.empty((REQ32_ROWS, upad), np.int32)
     mhead[:, :u] = m[:, starts]
     mhead[:, u:] = 0
@@ -1789,8 +1792,9 @@ class TickEngine:
         # Grouped batches (uniform duplicate groups — Zipf/hot-key
         # traffic) tick each unique head once with a closed-form follower
         # fold, then expand per-member responses elementwise: the
-        # scatter-add architecture from BASELINE.json.  Compiles lazily
-        # on the first grouped batch (warmup compiles stay bounded).
+        # scatter-add architecture from BASELINE.json.  Serving-scale
+        # engines warm it per width (see _warmup); small test-cluster
+        # engines compile lazily on the first grouped batch.
         self._tick32m = jitted_merged_pipeline(self.capacity, self.layout)
         # Tick widths: one narrow program for typical service batches
         # (≤ the reference's 1000-item batch limit) plus the full width.
@@ -1862,6 +1866,26 @@ class TickEngine:
                 self.state, jnp.asarray(m), jnp.int64(0)
             )
             np.asarray(resp)
+        # Warm the grouped (scatter-add) pipeline at each width's floor
+        # head shape (group_upad — the shape every sub-quantum hot-key
+        # window hits) so the first grouped batch doesn't pay the
+        # compile on a live deadline.  Deeper head widths stay lazy.
+        # Gated to serving-scale engines: test-cluster engines (small
+        # capacity, usually no duplicate traffic) skip the extra
+        # compiles.
+        if self.capacity >= (1 << 14):
+            for w in self._widths:
+                upad = group_upad(w)
+                mh = np.zeros((REQ32_ROWS, upad), np.int32)
+                mh[REQ32_INDEX["slot"]] = self.capacity
+                self.state, resp = self._tick32m(
+                    self.state, jnp.asarray(mh),
+                    jnp.ones(upad, np.int32),
+                    jnp.full(w, upad - 1, np.int32),
+                    jnp.zeros(w, np.int32),
+                    jnp.int64(0),
+                )
+                np.asarray(resp)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
         # Compile the reclaim dead-scan now too: its first invocation

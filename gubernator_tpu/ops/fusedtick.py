@@ -307,6 +307,13 @@ def _kernel(slots_ref, now_ref, m32_ref, table_ref, tout_ref, resp_ref,
 
             cnt = m32_ref[REQ32_ROWS:REQ32_ROWS + 1, pl.ds(base, C)]
             new_state, head = merged_fold32(now_pair, new_state, r, cnt)
+        # The write-buffer store comes FIRST: pair_body issues the row
+        # scatters right after compute_store returns, and filling wbuf
+        # before the response packing keeps the write DMAs from waiting
+        # on VPU work they don't depend on.
+        out = _transpose_bwd(_pstate_to_T(new_state))  # (C, TW)
+        wbuf[buf, :, :TW] = out
+        if merged:
             rows = list(merged24_rows(resp, head, r))
             rows += [jnp.zeros((1, C), I32)] * (MERGED24_ROWS - len(rows))
             # Row-major output via the same exact one-hot MXU transpose
@@ -323,8 +330,6 @@ def _kernel(slots_ref, now_ref, m32_ref, table_ref, tout_ref, resp_ref,
                 resp.reset_time.hi,
             ]
             resp_ref[:, pl.ds(base, C)] = jnp.concatenate(rows, axis=0)
-        out = _transpose_bwd(_pstate_to_T(new_state))  # (C, TW)
-        wbuf[buf, :, :TW] = out
 
     # Spare words of the write rows are zero for the whole kernel (rows
     # scatter whole-width; eviction/installs expect zeroed spares).
