@@ -201,6 +201,11 @@ def rung_kernel():
         "batch": batch,
         "samples": len(samples),
         "spread": round(spread, 3),
+        # Chip-health context: the tick is ~98% random row DMA, so
+        # ns/row exposes the device's per-descriptor floor for THIS run
+        # (measured 21.5 ns on an idle chip, ~33 ns on a shared/slow
+        # day — a 1.5x swing that is environment, not code).
+        "ns_per_row": round(per_tick * 1e9 / batch, 2),
         "vs_target_50m": round(rate / TARGET_DECISIONS, 4),
     }
 
