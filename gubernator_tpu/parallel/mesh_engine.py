@@ -145,6 +145,44 @@ class ShardedOps:
             (state_spec, P("shard", None, None)),
         )
 
+        # The parts-native program for duplicate-free windows (the
+        # production common case): host-dispatched as its OWN program —
+        # not a traced lax.cond next to the x64 tick — so the row layout
+        # keeps the fused Mosaic kernel per shard (Mosaic refuses x64
+        # traces; tick32 module doc).  The unfused variant returns its
+        # six response rows unstacked (CPU concat-fusion pathology) and
+        # stack6 reassembles the (shard, 6, B) block in its own program.
+        from gubernator_tpu.ops.tick32 import (
+            _resolve_fused, make_tick32_fn, make_tick32_rows_fn)
+
+        self._fused32 = layout == "row" and _resolve_fused(None)
+        if self._fused32:
+            tick32 = make_tick32_fn(local_capacity, layout)
+
+            def _tick32(state_blk, req_blk, now):
+                st, resp = tick32(state_blk, req_blk[0], now)
+                return st, resp[None]
+
+            self.tick_unique = smap(
+                _tick32,
+                (state_spec, P("shard", None, None), P()),
+                (state_spec, P("shard", None, None)),
+            )
+            self.stack6 = None
+        else:
+            tick32_rows = make_tick32_rows_fn(local_capacity, layout)
+
+            def _tick32(state_blk, req_blk, now):
+                st, rows = tick32_rows(state_blk, req_blk[0], now)
+                return st, tuple(r[None] for r in rows)
+
+            self.tick_unique = smap(
+                _tick32,
+                (state_spec, P("shard", None, None), P()),
+                (state_spec, tuple(P("shard", None) for _ in range(6))),
+            )
+            self.stack6 = jax.jit(lambda rows: jnp.stack(rows, axis=1))
+
         def _evict(state_blk, slots_blk):
             return evict(state_blk, slots_blk[0])
 
@@ -189,6 +227,14 @@ class ShardedOps:
             self.zeros_global(),
             self.state_shardings,
         )
+
+    def run_tick_unique(self, state, m_dev, now):
+        """Dispatch the duplicate-free tick; returns the (shard, 6, B)
+        response block whichever internal format the backend uses."""
+        state, out = self.tick_unique(state, m_dev, now)
+        if self.stack6 is not None:
+            out = self.stack6(out)
+        return state, out
 
     def put2(self, blk: np.ndarray):
         return jax.device_put(blk, self.block_sharding2)
@@ -305,10 +351,16 @@ class MeshTickEngine:
         """Compile the sharded tick at startup (see TickEngine._warmup)."""
         m = np.zeros((self.n_shards, REQ32_ROWS, self.max_batch), np.int32)
         m[:, REQ32_INDEX["slot"], :] = self.local_capacity
+        # Warm both programs: the merge-capable x64 tick and the
+        # duplicate-free parts tick.
         self.state, resp = self.ops.tick(
             self.state, self.ops.put3(m), jnp.int64(0)
         )
         np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
+        self.state, resp = self.ops.run_tick_unique(
+            self.state, self.ops.put3(m), jnp.int64(0)
+        )
+        np.asarray(resp)
         cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
         self.state = self.ops.install(
             self.state, self.ops.put3(cols), jnp.int64(0)
@@ -530,8 +582,8 @@ class MeshTickEngine:
             # Per-shard sorted-input contract: one argsort by
             # (shard, slot); error rows sort to each shard's end.
             safe_slots = np.where(resolved, slots, self.local_capacity)
-            order2 = np.argsort(
-                sh * (self.local_capacity + 1) + safe_slots, kind="stable")
+            key = sh * (self.local_capacity + 1) + safe_slots
+            order2 = np.argsort(key, kind="stable")
             sh2 = sh[order2]
             pos_sorted = np.arange(n, dtype=np.int64) - np.searchsorted(
                 sh2, np.arange(self.n_shards + 1))[sh2]
@@ -568,9 +620,25 @@ class MeshTickEngine:
             put_wide("greg_exp", greg_e[ix])
             put_wide("greg_dur", greg_d[ix])
 
-            self.state, resp = self.ops.tick(
-                self.state, self.ops.put3(m), jnp.int64(now)
-            )
+            # Duplicate-free windows (adjacent-equal check on the sort
+            # key already built for order2) dispatch the parts-native
+            # program — the fused Mosaic kernel per shard on the row
+            # layout; duplicate-bearing windows keep the merge-capable
+            # x64 program wholesale (cross-member sequencing).
+            key_sorted = key[order2]
+            slots_sorted = safe_slots[order2]
+            has_dups = bool(np.any(
+                (key_sorted[1:] == key_sorted[:-1])
+                & (slots_sorted[1:] < self.local_capacity)
+            ))
+            if has_dups:
+                self.state, resp = self.ops.tick(
+                    self.state, self.ops.put3(m), jnp.int64(now)
+                )
+            else:
+                self.state, resp = self.ops.run_tick_unique(
+                    self.state, self.ops.put3(m), jnp.int64(now)
+                )
             self._pending.clear()
             wt_args = None
             if self.store is not None:
