@@ -170,6 +170,7 @@ class V1Instance:
             metrics=self.metrics,
         )
         hash_fn = HASH_FUNCTIONS[conf.picker_hash]
+        self._standalone = True  # no peers installed yet; see set_peers
         self.local_picker: ReplicatedConsistentHash[PeerClient] = (
             ReplicatedConsistentHash(hash_fn, conf.replicas)
         )
@@ -346,13 +347,15 @@ class V1Instance:
 
     def columns_fast_path_ok(self) -> bool:
         """Whether GetRateLimits may run wire→columns→device with no
-        per-request objects: requires every key to be local (standalone,
-        no peers), no server-forced GLOBAL, no Store (read-through takes
-        request objects), and an engine speaking columns.  The transport
-        additionally falls back per batch when an item carries GLOBAL
-        behavior, metadata (trace context), or a validation error."""
+        per-request objects: requires every key to be local (standalone —
+        an empty peer set, or one containing only this node's own
+        entry, which discovery type "none" installs), no server-forced
+        GLOBAL, no Store (read-through takes request objects), and an
+        engine speaking columns.  The transport additionally falls back
+        per batch when an item carries GLOBAL behavior, metadata (trace
+        context), or a validation error."""
         return (
-            len(self.local_picker) == 0
+            self._standalone
             and self.global_mesh is None
             and not self.conf.behaviors.force_global
             and self.conf.store is None
@@ -619,6 +622,10 @@ class V1Instance:
 
         old_local, old_region = self.local_picker, self.region_picker
         self.local_picker, self.region_picker = local, region
+        # Standalone = no peers, or only our own entry (discovery "none"
+        # installs self): the columns fast path's gate, recomputed at the
+        # sole mutation point so the hot path reads one bool.
+        self._standalone = all(p.info.is_owner for p in local.peers())
 
         # Gracefully drain removed (and replaced) peers.
         doomed = replaced + [

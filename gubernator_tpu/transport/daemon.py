@@ -29,7 +29,7 @@ from gubernator_tpu.service.instance import (
     InstanceConfig,
     V1Instance,
 )
-from gubernator_tpu.transport import convert
+from gubernator_tpu.transport import convert, wire
 from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
 from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
 from gubernator_tpu.types import GlobalUpdate, PeerInfo
@@ -127,6 +127,11 @@ class V1Servicer:
                     )
                 except BatchTooLargeError as e:
                     await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+                if not errs:
+                    # Vectorized wire encoding straight from the matrix
+                    # (transport/wire.py); the method's pass-through
+                    # serializer ships these bytes as-is.
+                    return wire.encode_get_rate_limits_resp(mat)
                 status, limit, remaining, reset = (
                     mat[r].tolist() for r in range(4)
                 )

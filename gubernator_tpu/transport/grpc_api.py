@@ -32,7 +32,13 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
             "GetRateLimits": grpc.unary_unary_rpc_method_handler(
                 servicer.GetRateLimits,
                 request_deserializer=pb.GetRateLimitsReq.FromString,
-                response_serializer=pb.GetRateLimitsResp.SerializeToString,
+                # Pass-through for the vectorized wire encoder
+                # (transport/wire.py): the fast path hands back the
+                # already-encoded GetRateLimitsResp bytes; object
+                # responses (errors/metadata) still serialize normally.
+                response_serializer=lambda m: (
+                    m if isinstance(m, bytes) else m.SerializeToString()
+                ),
             ),
             "HealthCheck": grpc.unary_unary_rpc_method_handler(
                 servicer.HealthCheck,

@@ -1,0 +1,41 @@
+"""Vectorized response encoder vs message-object serialization."""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.pb import gubernator_pb2 as pb
+from gubernator_tpu.transport.wire import encode_get_rate_limits_resp
+
+
+def oracle(mat):
+    return pb.GetRateLimitsResp(responses=[
+        pb.RateLimitResp(
+            status=int(mat[0, i]), limit=int(mat[1, i]),
+            remaining=int(mat[2, i]), reset_time=int(mat[3, i]),
+        )
+        for i in range(mat.shape[1])
+    ]).SerializeToString()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_matches_message_objects(seed):
+    rng = np.random.default_rng(seed)
+    n = 257
+    mat = np.zeros((5, n), np.int64)
+    mat[0] = rng.integers(0, 2, n)                      # status enum
+    mat[1] = rng.choice([0, 1, 127, 128, 10**6, 1 << 40, (1 << 62)], n)
+    mat[2] = rng.choice([0, 5, -1, -(1 << 40), 10**6], n)  # negatives too
+    mat[3] = rng.choice([0, 1_700_000_000_000, 1 << 62], n)
+    assert encode_get_rate_limits_resp(mat) == oracle(mat)
+    # parse-back sanity
+    msg = pb.GetRateLimitsResp.FromString(encode_get_rate_limits_resp(mat))
+    assert len(msg.responses) == n
+    assert msg.responses[3].remaining == mat[2, 3]
+
+
+def test_empty_and_single():
+    assert encode_get_rate_limits_resp(np.zeros((5, 0), np.int64)) == b""
+    mat = np.zeros((5, 1), np.int64)
+    assert encode_get_rate_limits_resp(mat) == oracle(mat)  # all defaults
+    mat[2] = -9  # negative remaining alone
+    assert encode_get_rate_limits_resp(mat) == oracle(mat)
