@@ -31,6 +31,12 @@ def test_sharded_state_persists_across_ticks(engine):
     assert [r.remaining for r in out2] == [8] * 100
 
 
+@pytest.mark.skipif(
+    len(jax.devices()) < 6,
+    reason="needs a >=6-shard mesh: the assertions require ~all of 8 "
+           "shards populated and 200 keys exceed a small mesh's summed "
+           "128-slot shard capacity (GUBER_TEST_TPU runs single-chip)",
+)
 def test_keys_spread_across_shards(engine):
     engine.process([req(f"spread-{i}") for i in range(200)], now=NOW)
     per_shard = [len(sm) for sm in engine.slots]
