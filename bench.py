@@ -236,10 +236,14 @@ def rung_kernel_zipf():
     from gubernator_tpu.ops.transition32 import expand32_rows
 
     capacity = 1 << 20 if FAST else 10_000_000
-    batch = 1 << 15
+    # Zipf unique-head counts grow sub-linearly in batch width, so wide
+    # batches amortize the per-member expansion over fewer device rows:
+    # 32K decisions touch ~6.5K heads, 128K touch ~19.7K (3.3x the
+    # decisions for 3x the rows and 4x the expansion, measured 49.8 vs
+    # 44 M/s on the same chip).  FAST keeps the small shape.
+    batch = 1 << 15 if FAST else 1 << 17
     K = 4
     now = 1_700_000_000_000
-    layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
 
     rng = np.random.default_rng(7)
     plans = []
@@ -258,21 +262,29 @@ def rung_kernel_zipf():
         plan = build_group_plan(m, batch, capacity, now)
         assert plan is not None
         plans.append(plan)
-    upad = max(p[0].shape[1] for p in plans)
-    uniq = round(
-        float(np.mean([(p[0][R32["slot"]] < capacity).sum()
-                       for p in plans])), 1)
+    # Common head width for the chained plans: chunk-pair multiples,
+    # NOT a power of two — pow2 padding at U ~ 20K would DMA
+    # 16384-vs-20480 = 20-40% dead guard rows per tick.  (The ENGINE
+    # keeps pow2 quantization: serving must bound its compiled-shape
+    # count; the rung compiles one shape.)
+    uniq = round(float(np.mean([p[4] for p in plans])), 1)
+    # Multiple of 4096 = an EVEN number of the kernel's 2048-row chunks
+    # (the fused pipeline pairs chunks; nc must be 1 or even), with a
+    # 2048 floor for the nc == 1 case.
+    maxu = max(p[4] for p in plans)
+    upad = 2048 if maxu <= 2048 else -(-maxu // 4096) * 4096
+    # Layout by the KERNEL's staged width: the merged kernel sees upad
+    # head rows (~B/6 under Zipf), never the full member batch — the
+    # expansion handling members is plain XLA.
+    layout = make_layout_choice("auto", capacity, jax.devices()[0], upad)
 
     def repad(p):
-        mhead, count, uidx, rank, _ = p
-        u = mhead.shape[1]
-        if u == upad:
-            return mhead, count, uidx, rank
+        mhead, count, uidx, rank, u = p
         mh = np.zeros((REQ32_ROWS, upad), np.int32)
-        mh[:, :u] = mhead
+        mh[:, :u] = mhead[:, :u]
         mh[R32["slot"], u:] = capacity
         cnt = np.ones(upad, np.int32)
-        cnt[:u] = count
+        cnt[:u] = count[:u]
         return mh, cnt, uidx, rank
 
     plans = [repad(p) for p in plans]
@@ -317,8 +329,9 @@ def rung_kernel_zipf():
 
         return run
 
-    n = 10 if FAST else 60
-    per_tick, spread, samples = diff_time(chain, state, n, _resolve_chain)
+    n = 10 if FAST else 20
+    per_tick, spread, samples = diff_time(
+        chain, state, n, _resolve_chain, attempts=8)
     if per_tick is None:
         return {"rung": "kernel_zipf_10m", "decisions_per_sec": 0,
                 "batch": batch, "unreliable": True, "vs_target_50m": 0}
