@@ -207,7 +207,7 @@ async def test_mtls_cluster_forwarding(ca_files):
     # Find a key d1 does NOT own so the request forwards over mTLS.
     # set_peers applies asynchronously — poll until the picker is live.
     key = None
-    for _ in range(100):
+    for _ in range(300):  # up to 15s: suite-load makes propagation slow
         for i in range(64):
             cand = f"k{i}"
             peer = d1.instance.get_peer(f"test_tls_{cand}")
@@ -219,7 +219,7 @@ async def test_mtls_cluster_forwarding(ca_files):
         await asyncio.sleep(0.05)
     probe = d1.instance.get_peer("test_tls_k0")
     assert key is not None, (
-        f"no non-owned key after 5s: d1={d1.conf.grpc_listen_address} "
+        f"no non-owned key after 15s: d1={d1.conf.grpc_listen_address} "
         f"d2={d2.conf.grpc_listen_address} peers={d1.peer_info} "
         f"probe={(probe.info if probe else None)}"
     )
