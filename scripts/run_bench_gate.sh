@@ -27,6 +27,9 @@ if [ -z "$baseline" ]; then
 fi
 
 out=$(mktemp)
-BENCH_FAST=1 python bench.py | tail -1 > "$out"
+# Pin the CPU backend: the gate compares against a CPU baseline, and a
+# stale JAX_PLATFORMS from the environment (e.g. a TPU-plugin dev shell)
+# must not leak into the candidate run.
+JAX_PLATFORMS=cpu BENCH_FAST=1 python bench.py | tail -1 > "$out"
 echo "candidate: $(cat "$out" | head -c 300)..."
 python scripts/check_bench_regression.py "$baseline" "$out"
