@@ -37,6 +37,12 @@ def _ms(v: float) -> float:
     return v / 1000.0
 
 
+# Reference BatchLimit default (config.go:126-128).  Single source for the
+# field default, the explicit-set detection, and the env reader default —
+# they must agree or batch_limit_set desyncs.
+DEFAULT_BATCH_LIMIT = 1000
+
+
 @dataclass
 class BehaviorConfig:
     """Batching and GLOBAL cadence knobs (reference config.go:49-70).
@@ -47,7 +53,12 @@ class BehaviorConfig:
     # Client→owner forwarding batches.
     batch_timeout: float = 0.5       # BatchTimeout 500ms
     batch_wait: float = 500e-6       # BatchWait 500µs (the tick)
-    batch_limit: int = 1000          # BatchLimit
+    batch_limit: int = DEFAULT_BATCH_LIMIT   # BatchLimit
+    # True when the operator set GUBER_BATCH_LIMIT (or a caller assigned
+    # batch_limit explicitly).  The tick window honors an explicit cap —
+    # even one equal to the reference default — and otherwise widens to
+    # tpu_max_batch (service/instance.py window_limit).
+    batch_limit_set: bool = False
 
     disable_batching: bool = False
 
@@ -58,6 +69,13 @@ class BehaviorConfig:
     global_peer_requests_concurrency: int = 100
 
     force_global: bool = False
+
+    def __post_init__(self) -> None:
+        # Programmatic construction with a tuned batch_limit counts as
+        # explicit, so such callers keep their cap without knowing about
+        # the flag; only "left at the default" widens the tick window.
+        if self.batch_limit != DEFAULT_BATCH_LIMIT:
+            self.batch_limit_set = True
 
 
 @dataclass
@@ -268,6 +286,11 @@ class EnvReader:
         v = self.env.get(name, "")
         return v if v != "" else default
 
+    def has(self, name: str) -> bool:
+        """True when the var is set non-empty (the readers above treat an
+        empty string as unset)."""
+        return self.env.get(name, "") != ""
+
     def int_(self, name: str, default: int = 0) -> int:
         v = self.env.get(name, "")
         if v == "":
@@ -334,7 +357,8 @@ def setup_daemon_config(
     behaviors = BehaviorConfig(
         batch_timeout=r.float_seconds("GUBER_BATCH_TIMEOUT", 0.5),
         batch_wait=r.float_seconds("GUBER_BATCH_WAIT", 500e-6),
-        batch_limit=r.int_("GUBER_BATCH_LIMIT", 1000),
+        batch_limit=r.int_("GUBER_BATCH_LIMIT", DEFAULT_BATCH_LIMIT),
+        batch_limit_set=r.has("GUBER_BATCH_LIMIT"),
         disable_batching=r.bool_("GUBER_DISABLE_BATCHING"),
         global_timeout=r.float_seconds("GUBER_GLOBAL_TIMEOUT", 0.5),
         global_sync_wait=r.float_seconds("GUBER_GLOBAL_SYNC_WAIT", 0.1),

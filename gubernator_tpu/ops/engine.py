@@ -455,9 +455,12 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
 
     ``uidx``/``rank`` address the expansion program
     (transition32.expand32_rows): member i's response derives from head column
-    ``uidx[i]`` at rank ``rank[i]``; lanes past ``n`` and error lanes
-    point at a padding head column and stay unspecified, like the plain
-    tick's padding lanes."""
+    ``uidx[i]`` at rank ``rank[i]``.  Error lanes keep their real group
+    head (they share its slot run) and lanes past ``n`` point at column
+    ``upad - 1`` — which aliases the last real head when ``u == upad``.
+    Both are harmless: their response values are unspecified and are
+    sliced/masked downstream exactly like the plain tick's padding
+    lanes."""
     R = REQ32_INDEX
     b = m.shape[1]
     s = m[R["slot"], :n]

@@ -156,11 +156,11 @@ class V1Instance:
         # when several callers' batches coalesce into one program
         # invocation (the reference's worker pool has no analogous cap —
         # it drains whatever queued, workers.go:125-147).  An operator
-        # who explicitly tunes GUBER_BATCH_LIMIT away from the
-        # reference default still caps the window with it.
+        # who explicitly set GUBER_BATCH_LIMIT — even to the reference
+        # default of 1000 — caps the window with it.
         window_limit = (
             conf.behaviors.batch_limit
-            if conf.behaviors.batch_limit != 1000
+            if conf.behaviors.batch_limit_set
             else conf.tpu_max_batch
         )
         self.tick_loop = TickLoop(
@@ -621,11 +621,19 @@ class V1Instance:
             local.add(peer)
 
         old_local, old_region = self.local_picker, self.region_picker
-        self.local_picker, self.region_picker = local, region
         # Standalone = no peers, or only our own entry (discovery "none"
         # installs self): the columns fast path's gate, recomputed at the
-        # sole mutation point so the hot path reads one bool.
-        self._standalone = all(p.info.is_owner for p in local.peers())
+        # sole mutation point so the hot path reads one bool.  Ordering
+        # matters: when remote peers arrive, clear the flag BEFORE the
+        # picker swap; when they leave, set it AFTER — either way the
+        # fast path never sees standalone=True with remote peers live
+        # (worst case it conservatively takes the slow path for a beat).
+        standalone = all(p.info.is_owner for p in local.peers())
+        if not standalone:
+            self._standalone = False
+        self.local_picker, self.region_picker = local, region
+        if standalone:
+            self._standalone = True
 
         # Gracefully drain removed (and replaced) peers.
         doomed = replaced + [

@@ -61,10 +61,19 @@ def rates(doc):
     gate compares like-for-like PR-vs-master runs on one runner).  The
     spread is the rung's recorded sample dispersion ((max-min)/max of its
     median-of-k samples, bench.diff_time); the gate widens its threshold
-    by both files' spreads so a noisy-but-honest rung doesn't flap."""
+    by both files' spreads so a noisy-but-honest rung doesn't flap.
+
+    The headline's shape key is its ``headline_rung`` (when recorded):
+    bench.py headlines the max of several kernel rungs, so two records
+    whose leading rung differs would compare different workloads under
+    one name — the shape mismatch path reports that instead of judging
+    it."""
     out = {}
     if doc.get("value") is not None:
-        out["headline"] = (float(doc["value"]), (), 0.0)
+        shape = ()
+        if doc.get("headline_rung"):
+            shape = (("headline_rung", doc["headline_rung"]),)
+        out["headline"] = (float(doc["value"]), shape, 0.0)
     for rung in doc.get("ladder", []):
         shape = tuple(
             (k, rung[k]) for k in ("keys", "batch", "nodes") if k in rung
@@ -101,6 +110,12 @@ def main():
                   f"{'candidate' if bs is None else 'baseline'} — not gated")
             continue
         (b, b_shape, b_spread), (c, c_shape, c_spread) = bs, cs
+        if name == "headline" and (not b_shape or not c_shape):
+            # Legacy records (r01–r04) don't carry headline_rung; a
+            # missing value is a wildcard, not a mismatch — only two
+            # records that BOTH name their leading rung differently
+            # compare different workloads.
+            b_shape = c_shape = ()
         if b_shape != c_shape:
             print(f"  {name}: workload shape differs "
                   f"({dict(b_shape)} vs {dict(c_shape)}) — not gated")
@@ -112,7 +127,12 @@ def main():
             continue
         # Spread-aware slack: a rung whose own samples disperse by s can
         # legitimately move by (1+s) run-to-run; both runs contribute.
-        allowed = args.threshold * (1 + b_spread) * (1 + c_spread)
+        # Each side's slack is capped at 1.5x so a wildly noisy rung
+        # (r04 spreads ~0.75 → ~6x allowed slowdown) can't neuter the
+        # gate — a measurement that bad should fail and force a re-run
+        # or a tighter rung, not wave regressions through.
+        allowed = (args.threshold
+                   * min(1 + b_spread, 1.5) * min(1 + c_spread, 1.5))
         slowdown = b / c
         mark = "FAIL" if slowdown > allowed else "ok"
         if slowdown > allowed:
