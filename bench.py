@@ -56,16 +56,29 @@ def _pcts(samples_ms):
     )
 
 
-def diff_time(chain, state, n, resolve, attempts=5, spread_goal=0.20):
+def _trimmed_spread(samples, k):
+    """Dispersion of the ``k`` samples nearest the median, as
+    (max-min)/max — the spread of the measurement's core, insensitive to
+    a single tunnel spike the median already rejects.  Callers record it
+    alongside the full-range spread so the record shows both."""
+    med = float(np.median(samples))
+    core = sorted(samples, key=lambda s: abs(s - med))[:k]
+    return (max(core) - min(core)) / max(core)
+
+
+def diff_time(chain, state, n, resolve, attempts=10, spread_goal=0.20,
+              min_samples=5):
     """Shared chained-differential methodology for device rungs.
 
     ``chain(iters)`` builds a jitted runner of ``iters`` chained ticks;
     per-op = (t(2n) - t(n)) / n with best-of-3 per length so dispatch
     and tunnel round-trip cancel; ``resolve(out)`` materializes a
     host-side value (block_until_ready returns early on this platform).
-    Repeats until >= 3 positive samples agree within ``spread_goal`` or
-    attempts run out; returns (median_seconds, spread, samples) or
-    (None, None, samples) when the tunnel noise won.
+    Collects positive samples until >= ``min_samples`` agree (trimmed
+    spread, :func:`_trimmed_spread`) within ``spread_goal`` or attempts
+    run out; returns (median_seconds, spread, samples) — spread is the
+    trimmed core's — or (None, None, samples) when fewer than 3 clean
+    samples emerged (tunnel noise won; not a measurement).
     """
     runs = {k: chain(k) for k in (n, 2 * n)}
     for r in runs.values():  # compile + warm
@@ -84,13 +97,13 @@ def diff_time(chain, state, n, resolve, attempts=5, spread_goal=0.20):
         per = (timed(runs[2 * n]) - timed(runs[n])) / n
         if per > 0:
             samples.append(per)
-        if len(samples) >= 3:
-            if (max(samples) - min(samples)) / max(samples) < spread_goal:
-                break
+        if (len(samples) >= min_samples
+                and _trimmed_spread(samples, min_samples) < spread_goal):
+            break
     if len(samples) < 3:
         return None, None, samples
     per = float(np.median(samples))
-    spread = (max(samples) - min(samples)) / max(samples)
+    spread = _trimmed_spread(samples, min(min_samples, len(samples)))
     return per, spread, samples
 
 
@@ -201,6 +214,7 @@ def rung_kernel():
         "batch": batch,
         "samples": len(samples),
         "spread": round(spread, 3),
+        "spread_all": round(_trimmed_spread(samples, len(samples)), 3),
         # Chip-health context: the tick is ~98% random row DMA, so
         # ns/row exposes the device's per-descriptor floor for THIS run
         # (measured 21.5 ns on an idle chip, ~33 ns on a shared/slow
@@ -330,8 +344,7 @@ def rung_kernel_zipf():
         return run
 
     n = 10 if FAST else 20
-    per_tick, spread, samples = diff_time(
-        chain, state, n, _resolve_chain, attempts=8)
+    per_tick, spread, samples = diff_time(chain, state, n, _resolve_chain)
     if per_tick is None:
         return {"rung": "kernel_zipf_10m", "decisions_per_sec": 0,
                 "batch": batch, "unreliable": True, "vs_target_50m": 0}
@@ -346,6 +359,7 @@ def rung_kernel_zipf():
         "layout": layout,
         "samples": len(samples),
         "spread": round(spread, 3),
+        "spread_all": round(_trimmed_spread(samples, len(samples)), 3),
         "vs_target_50m": round(rate / TARGET_DECISIONS, 4),
     }
 
@@ -439,17 +453,18 @@ def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=40
 
     # Throughput: pipelined — dispatch runs ahead, responses resolved 16
     # ticks at a time in one D2H transfer each (engine.resolve_ticks).
-    # Timed in 3 segments so the record carries the tunnel's run-to-run
+    # Timed in 5 segments so the record carries the tunnel's run-to-run
     # spread (round-3 verdict: single-shot transport rungs can't gate a
     # 200% threshold under 300% link noise); the rate is the median
-    # segment's.
+    # segment's, its spread the middle-3 segments' dispersion (the
+    # full-range figure is spread_all).
     from gubernator_tpu.ops.engine import resolve_ticks
 
     seg_rates = []
     done = 0
     tick_i = 0
     t0 = time.perf_counter()
-    for seg_ticks in (ticks // 3, ticks // 3, ticks - 2 * (ticks // 3)):
+    for seg_ticks in [ticks // 5] * 4 + [ticks - 4 * (ticks // 5)]:
         s0 = time.perf_counter()
         seg_done = 0
         pending = []
@@ -476,13 +491,15 @@ def rung_engine(label, n_keys, algo, ticks, zipf=False, fresh_frac=0.0, batch=40
         lat.append((time.perf_counter() - t1) * 1e3)
     p50, p99 = _pcts(lat)
     seg = sorted(seg_rates)
+    core = seg[1:-1] if len(seg) >= 5 else seg
     out = {
         "rung": label,
         "keys": n_keys,
         "fill_s": round(fill_s, 1),
         "decisions_per_sec": round(seg[len(seg) // 2], 1),
         "decisions_per_sec_overall": round(done / dt, 1),
-        "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
+        "spread": round((core[-1] - core[0]) / max(core[-1], 1e-9), 3),
+        "spread_all": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
         "batch": batch,
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
@@ -1288,43 +1305,102 @@ def main():
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
 
-    print(
-        json.dumps(
-            {
-                "metric": "rate_limit_decisions_per_sec_per_chip",
-                "value": head.get("decisions_per_sec", 0),
-                "unit": "decisions/s",
-                "headline_rung": head.get("rung"),
-                # BENCH_FAST shortens the kernel rung's differential
-                # chains (n=20 vs 100) below the tunnel-jitter floor —
-                # fast-mode headlines carry ~4x noise and are marked so
-                # they are never read as the record.
-                "fast_mode": FAST,
-                "vs_baseline": head.get("vs_target_50m", 0),
-                "p99_ms_at_10m_keys": big_p99,
-                # Engine latencies ride one device dispatch+D2H per tick;
-                # over a tunneled device that roundtrip (rt_ms, ≈0.1ms on
-                # local hardware) dominates p99 — the net figure estimates
-                # the local-deployment latency.
-                "p99_net_of_roundtrip_ms": (
-                    round(max(0.0, big_p99 - rt_ms), 3)
-                    if isinstance(big_p99, (int, float)) else None
-                ),
-                "p99_target_ms": TARGET_P99_MS,
-                # Transport-free device evidence for the 2 ms bar: the
-                # p99_projection rung's 4096-wide projected-local figure.
-                "p99_projected_local_ms": next(
-                    (r.get("w4096", {}).get("p99_projected_local_ms")
-                     for r in ladder if r.get("rung") == "p99_projection"),
-                    None,
-                ),
-                "device_roundtrip_ms": rt_ms,
-                "h2d_mbps": h2d_mbps,
-                "d2h_mbps": d2h_mbps,
-                "ladder": ladder,
-            }
-        )
+    record = {
+        "metric": "rate_limit_decisions_per_sec_per_chip",
+        "value": head.get("decisions_per_sec", 0),
+        "unit": "decisions/s",
+        "headline_rung": head.get("rung"),
+        # BENCH_FAST shortens the kernel rung's differential
+        # chains (n=20 vs 100) below the tunnel-jitter floor —
+        # fast-mode headlines carry ~4x noise and are marked so
+        # they are never read as the record.
+        "fast_mode": FAST,
+        "vs_baseline": head.get("vs_target_50m", 0),
+        "p99_ms_at_10m_keys": big_p99,
+        # Engine latencies ride one device dispatch+D2H per tick;
+        # over a tunneled device that roundtrip (rt_ms, ≈0.1ms on
+        # local hardware) dominates p99 — the net figure estimates
+        # the local-deployment latency.
+        "p99_net_of_roundtrip_ms": (
+            round(max(0.0, big_p99 - rt_ms), 3)
+            if isinstance(big_p99, (int, float)) else None
+        ),
+        "p99_target_ms": TARGET_P99_MS,
+        # Transport-free device evidence for the 2 ms bar: the
+        # p99_projection rung's 4096-wide projected-local figure.
+        "p99_projected_local_ms": next(
+            (r.get("w4096", {}).get("p99_projected_local_ms")
+             for r in ladder if r.get("rung") == "p99_projection"),
+            None,
+        ),
+        "device_roundtrip_ms": rt_ms,
+        "h2d_mbps": h2d_mbps,
+        "d2h_mbps": d2h_mbps,
+        "ladder": ladder,
+    }
+    # Full ladder record goes to a FILE; the final stdout line is a
+    # compact headline that fits the driver's 2000-char tail capture —
+    # round 4's record came back "parsed": null because the full ladder
+    # outgrew the tail (the only place the driver reads the result from).
+    out_path = os.environ.get(
+        "BENCH_LOCAL_OUT",
+        # Fast-mode (CI gate) runs must not clobber the round record.
+        "BENCH_local_fast.json" if FAST else "BENCH_local_r05.json",
     )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"[bench] ladder file write failed: {e}", file=sys.stderr)
+    print(json.dumps(compact_headline(record, out_path)))
+
+
+def compact_headline(record, ladder_file):
+    """Distill the full record into a ≲1.5 KB summary: the headline metric
+    plus [rate, spread] per throughput rung and the latency/link context —
+    enough for the regression gate and the round record without the
+    ladder's bulk (which lives in ``ladder_file``)."""
+    rungs = {}
+    extras = {}
+    errors = []
+    for r in record["ladder"]:
+        name = r.get("rung", "?")
+        if "error" in r:
+            errors.append(name)
+            continue
+        rate = r.get("decisions_per_sec") or r.get("requests_per_sec")
+        if rate:
+            rungs[name] = [rate, r.get("spread")]
+        if name == "herd_device" and "herd_mixed" in r:
+            extras["herd_mixed_vs_unique"] = (
+                r["herd_mixed"].get("vs_unique_device"))
+        if name == "service_grpc":
+            extras["serve_cpu_ms_per_batch"] = r.get(
+                "serve_cpu_ms_per_batch")
+            extras["grpc_p99_projected_local_ms"] = r.get(
+                "batch_p99_projected_local_ms")
+        if name == "snapshot_10m":
+            extras["snapshot_export_s"] = r.get("export_s")
+    head = {
+        k: record[k]
+        for k in (
+            "metric", "value", "unit", "headline_rung", "fast_mode",
+            "vs_baseline", "p99_ms_at_10m_keys", "p99_projected_local_ms",
+            "device_roundtrip_ms", "h2d_mbps", "d2h_mbps",
+        )
+    }
+    for r in record["ladder"]:
+        if r.get("rung") == record.get("headline_rung"):
+            head["headline_samples"] = r.get("samples")
+            head["headline_spread"] = r.get("spread")
+            head["headline_spread_all"] = r.get("spread_all")
+    head["rungs"] = rungs
+    head.update(extras)
+    if errors:
+        head["rung_errors"] = errors
+    head["ladder_file"] = ladder_file
+    return head
 
 
 if __name__ == "__main__":

@@ -84,6 +84,14 @@ def rates(doc):
                     float(rung[k]), shape, float(rung.get("spread") or 0.0)
                 )
                 break
+    # Compact headline records (bench.py's final stdout line, the only
+    # thing the driver's BENCH_r*.json tail holds) carry {rung: [rate,
+    # spread]} without workload shapes; None marks "shape unknown" so
+    # the gate can wildcard it against a shaped record of the same rung.
+    for name, rs in doc.get("rungs", {}).items():
+        if name not in out and rs and rs[0]:
+            out[name] = (float(rs[0]), None,
+                         float(rs[1] or 0.0) if len(rs) > 1 else 0.0)
     return out
 
 
@@ -98,8 +106,19 @@ def main():
                          "(manual cross-shape comparisons)")
     args = ap.parse_args()
 
-    base = rates(load_bench(args.baseline))
-    cand = rates(load_bench(args.candidate))
+    base_doc, cand_doc = load_bench(args.baseline), load_bench(args.candidate)
+    modes_known = (base_doc.get("fast_mode") is not None
+                   and cand_doc.get("fast_mode") is not None)
+    if modes_known and base_doc["fast_mode"] != cand_doc["fast_mode"]:
+        # Shapeless compact records can't rely on per-rung shape keys to
+        # catch a FAST-vs-full mismatch; the mode flag is the guard.
+        if args.allow_empty:
+            print("fast_mode differs between records — skipped")
+            sys.exit(0)
+        print("fast_mode differs between records — not comparable; FAIL")
+        sys.exit(1)
+    base = rates(base_doc)
+    cand = rates(cand_doc)
 
     failed = False
     gated = 0
@@ -116,6 +135,18 @@ def main():
             # records that BOTH name their leading rung differently
             # compare different workloads.
             b_shape = c_shape = ()
+        if b_shape is None or c_shape is None:
+            # Compact record: shape unknown.  Wildcard it ONLY when both
+            # records declared a (matching) fast_mode — a salvaged tail
+            # without the flag could be full-size while the compact side
+            # is FAST, and gating those cross-shape is exactly what the
+            # shape keys exist to prevent.
+            if modes_known:
+                b_shape = c_shape = ()
+            else:
+                print(f"  {name}: shapeless compact rung vs record "
+                      "without fast_mode — not gated")
+                continue
         if b_shape != c_shape:
             print(f"  {name}: workload shape differs "
                   f"({dict(b_shape)} vs {dict(c_shape)}) — not gated")
