@@ -63,6 +63,11 @@ class ReqColumns:
     created_at: np.ndarray    # CREATED_UNSET where the server stamps now
     burst: np.ndarray
     refs: Optional[Sequence[RateLimitRequest]] = None
+    # Byte length of the *name* part of each packed key (the '_' split
+    # position) — lets the wire codec re-emit the two proto string
+    # fields from the packed key without re-splitting.  Optional: only
+    # the transport paths that re-encode need it.
+    name_len: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return len(self.hits)
@@ -87,8 +92,12 @@ class ReqColumns:
         n = len(requests)
         if n == 0:
             return cls.empty()
+        names = [r.name for r in requests]
         blob, offsets = key_blob_from_parts(
-            [r.name for r in requests], [r.unique_key for r in requests]
+            names, [r.unique_key for r in requests]
+        )
+        name_len = np.fromiter(
+            (len(nm.encode()) for nm in names), np.int64, count=n
         )
         hits, limit, duration, algo, behav, created, burst = zip(*(
             (
@@ -104,6 +113,7 @@ class ReqColumns:
             blob, offsets, a(hits), a(limit), a(duration),
             a(algo), a(behav), a(created), a(burst),
             refs=requests if keep_refs else None,
+            name_len=name_len,
         )
 
     def slice_chunk(self, s: int, e: int) -> "ReqColumns":
@@ -117,6 +127,7 @@ class ReqColumns:
             self.algorithm[s:e], self.behavior[s:e],
             self.created_at[s:e], self.burst[s:e],
             refs=None if self.refs is None else self.refs[s:e],
+            name_len=None if self.name_len is None else self.name_len[s:e],
         )
 
     @classmethod
@@ -142,10 +153,15 @@ class ReqColumns:
                 refs = None
                 break
             refs.extend(p.refs)
+        name_len = (
+            cat("name_len")
+            if all(p.name_len is not None for p in parts) else None
+        )
         return cls(
             b"".join(p.key_blob for p in parts), offsets,
             cat("hits"), cat("limit"), cat("duration"), cat("algorithm"),
             cat("behavior"), cat("created_at"), cat("burst"), refs=refs,
+            name_len=name_len,
         )
 
 

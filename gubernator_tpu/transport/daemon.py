@@ -29,7 +29,7 @@ from gubernator_tpu.service.instance import (
     InstanceConfig,
     V1Instance,
 )
-from gubernator_tpu.transport import convert, wire
+from gubernator_tpu.transport import convert, fastwire
 from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
 from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
 from gubernator_tpu.types import GlobalUpdate, PeerInfo
@@ -109,17 +109,42 @@ class _TraceInterceptor(grpc.aio.ServerInterceptor):
 
 
 class V1Servicer:
-    """pb ↔ dataclass edge for the public service."""
+    """pb ↔ dataclass edge for the public service.
+
+    ``GetRateLimits`` receives the RAW request bytes (the method handler
+    registers a pass-through deserializer, transport/grpc_api.py): the
+    hot path never materializes protobuf message objects — native wire
+    parse (transport/fastwire.py) → columns → device tick → native wire
+    encode.  The object-routing path (clustered / GLOBAL / metadata /
+    per-item errors / codec unavailable) parses with protobuf as before.
+    """
 
     def __init__(self, instance: V1Instance):
         self.instance = instance
 
-    async def GetRateLimits(self, request, context):
-        # Columnar fast path: wire → numpy columns → device → wire, no
-        # per-request Python objects.  Falls back to the object-routing
-        # path for clustered/GLOBAL/stored/erroneous traffic.
+    @staticmethod
+    async def _from_string(raw: bytes, context):
+        """Protobuf-parse raw request bytes; malformed input aborts with
+        INVALID_ARGUMENT (the status a deserializer failure produced
+        before the pass-through deserializer moved parsing in here —
+        without this, DecodeError would surface as UNKNOWN plus a server
+        traceback per bad request)."""
+        try:
+            return pb.GetRateLimitsReq.FromString(raw)
+        except Exception as e:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"failed to parse GetRateLimitsReq: {e}",
+            )
+
+    async def GetRateLimits(self, raw: bytes, context):
+        msg = None
         if self.instance.columns_fast_path_ok():
-            cols, errors, special = convert.columns_from_pb(request.requests)
+            parsed = fastwire.parse_req(raw)
+            if parsed is None:  # codec unavailable or malformed bytes
+                msg = await self._from_string(raw, context)
+                parsed = convert.columns_from_pb(msg.requests)
+            cols, errors, special = parsed
             if not special and not errors:
                 try:
                     mat, errs = await self.instance.get_rate_limits_columns(
@@ -128,10 +153,10 @@ class V1Servicer:
                 except BatchTooLargeError as e:
                     await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
                 if not errs:
-                    # Vectorized wire encoding straight from the matrix
-                    # (transport/wire.py); the method's pass-through
-                    # serializer ships these bytes as-is.
-                    return wire.encode_get_rate_limits_resp(mat)
+                    # Native wire encoding straight from the matrix; the
+                    # method's pass-through serializer ships these bytes
+                    # as-is.
+                    return fastwire.encode_resp(mat)
                 status, limit, remaining, reset = (
                     mat[r].tolist() for r in range(4)
                 )
@@ -146,9 +171,11 @@ class V1Servicer:
                     )
                     for i in range(len(status))
                 ])
+        if msg is None:
+            msg = await self._from_string(raw, context)
         try:
             out = await self.instance.get_rate_limits(
-                convert.reqs_from_pb(request.requests)
+                convert.reqs_from_pb(msg.requests)
             )
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
@@ -471,6 +498,13 @@ class DaemonClient:
         else:
             self.channel = grpc.aio.insecure_channel(address)
         self.stub = V1Stub(self.channel)
+        # Raw-bytes method for the columnar client path: the native
+        # codec produces/consumes the wire bytes; grpc just ships them.
+        self._raw_get_rate_limits = self.channel.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
 
     async def get_rate_limits(self, reqs, timeout: float = 5.0):
         msg = pb.GetRateLimitsReq(requests=[convert.req_to_pb(r) for r in reqs])
@@ -480,6 +514,37 @@ class DaemonClient:
             msg, timeout=timeout, metadata=tuple(hdrs.items()) or None
         )
         return [convert.resp_from_pb(r) for r in out.responses]
+
+    async def get_rate_limits_columns(self, cols, timeout: float = 5.0):
+        """Columnar client fast path: a :class:`ReqColumns` batch (with
+        ``name_len``) → native wire encode → raw gRPC → native wire
+        decode → ((4, n) status/limit/remaining/reset_time matrix,
+        {index: error string}).  Raises RuntimeError when the native
+        codec is unavailable — callers keep the object API then."""
+        import numpy as np
+
+        raw = fastwire.encode_req(cols)
+        if raw is None:
+            raise RuntimeError(
+                "native wire codec unavailable (build native/ or use "
+                "get_rate_limits)"
+            )
+        hdrs: dict = {}
+        tracing.inject(hdrs)
+        out = await self._raw_get_rate_limits(
+            raw, timeout=timeout, metadata=tuple(hdrs.items()) or None
+        )
+        parsed = fastwire.parse_resp(out)
+        if parsed is None:  # pragma: no cover - encode side proved lib ok
+            raise RuntimeError("native wire codec failed to parse response")
+        mat, special = parsed
+        errors = {}
+        if special.any():
+            msg = pb.GetRateLimitsResp.FromString(out)
+            for i in np.flatnonzero(special):
+                if msg.responses[i].error:
+                    errors[int(i)] = msg.responses[i].error
+        return mat, errors
 
     async def health_check(self, timeout: float = 5.0):
         return await self.stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)

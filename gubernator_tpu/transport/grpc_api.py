@@ -31,11 +31,12 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
         {
             "GetRateLimits": grpc.unary_unary_rpc_method_handler(
                 servicer.GetRateLimits,
-                request_deserializer=pb.GetRateLimitsReq.FromString,
-                # Pass-through for the vectorized wire encoder
-                # (transport/wire.py): the fast path hands back the
-                # already-encoded GetRateLimitsResp bytes; object
-                # responses (errors/metadata) still serialize normally.
+                # Pass-through BOTH ways: the servicer parses the raw
+                # bytes with the native codec (transport/fastwire.py)
+                # and the fast path hands back already-encoded
+                # GetRateLimitsResp bytes; object responses (errors/
+                # metadata) still serialize normally.
+                request_deserializer=lambda b: b,
                 response_serializer=lambda m: (
                     m if isinstance(m, bytes) else m.SerializeToString()
                 ),
