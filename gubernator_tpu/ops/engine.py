@@ -754,6 +754,22 @@ def _seg_min_all(is_start, val):
     return jnp.minimum(fwd, bwd)
 
 
+def _seg_max_all(is_start, val):
+    """Per-row maximum of ``val`` over the row's whole segment (mirror of
+    :func:`_seg_min_all`)."""
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, jnp.maximum(va, vb))
+
+    fwd = lax.associative_scan(combine, (is_start, val))[1]
+    last = jnp.concatenate([is_start[1:], jnp.ones((1,), jnp.bool_)])
+    bwd = lax.associative_scan(
+        combine, (last[::-1], val[::-1])
+    )[1][::-1]
+    return jnp.maximum(fwd, bwd)
+
+
 def _sorted_merge_plan(reqs: ReqBatch, is_start: jnp.ndarray):
     """Static fold structure for a slot-sorted batch: the ``ok``
     fold-eligibility predicate and the end index of each row's *unit*
@@ -805,7 +821,8 @@ def _sorted_merge_plan(reqs: ReqBatch, is_start: jnp.ndarray):
 
 def make_tick_fn(capacity: int, merge_uniform: bool = True,
                  layout: str = "columns", sorted_input: bool = False,
-                 compact_resp: bool = False, compact_req: bool = False):
+                 compact_resp: bool = False, compact_req: bool = False,
+                 unit_unroll: int = 8):
     """Build the jittable tick: (state, reqs, now) → (state, responses).
 
     Pure function of its inputs (no clocks, no host state) so the driver can
@@ -874,15 +891,18 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
             def cond(carry):
                 return ~jnp.all(carry[0])
 
-            def body(carry):
-                applied, st, resp = carry
+            def sub_step(applied, g, resp, last_head):
+                """Apply, per slot, the first unapplied unit (head
+                transition + closed-form fold) entirely in registers:
+                ``g`` holds each row's view of its slot's CURRENT state,
+                updated by forward propagation — no gather or scatter per
+                unit (those happen once per round, in ``body``)."""
                 cand = ~applied
                 headpos = _seg_min_all(
                     is_start, jnp.where(cand, idx, jnp.int32(b))
                 )
                 head = cand & (idx == headpos)
-                gathered = _gather(st, reqs.slot)
-                new_g, r_out = bucket_transition(now, gathered, reqs)
+                new_g, r_out = bucket_transition(now, g, reqs)
                 resp = jax.tree.map(
                     lambda old, new: jnp.where(head, new, old), resp, r_out
                 )
@@ -902,9 +922,54 @@ def make_tick_fn(capacity: int, merge_uniform: bool = True,
                     head_mask=head & (uend - hpos > 1),
                     R0=R0, F0=F0, S0=S0, E=E,
                 )
-                scat = jnp.where(head, reqs.slot, capacity)
-                st = _scatter(st, scat, rows)
-                return applied | head | merged, st, resp
+                # Chain units in-register: broadcast the head's
+                # unit-final row state forward over its segment so the
+                # next sub-step's head (the following unit of the same
+                # slot) transitions from post-unit state.  The
+                # propagated ``head`` flag distinguishes spans whose
+                # nearest boundary is a live head from spans headed by a
+                # stale segment start (those keep their state).
+                prop = _seg_propagate(is_start | head, (head,) + tuple(rows))
+                from_head = prop[0]
+                g = jax.tree.map(
+                    lambda cur, pv: jnp.where(from_head, pv, cur),
+                    g, type(rows)(*prop[1:]),
+                )
+                applied = applied | head | merged
+                last_head = jnp.where(head, idx, last_head)
+                return applied, g, resp, last_head
+
+            def body(carry):
+                applied, st, resp = carry
+                g = _gather(st, reqs.slot)
+                sc = (applied, g, resp, jnp.full(b, -1, jnp.int32))
+                # unit_unroll units per slot per ROUND: one gather and
+                # one scatter amortize over up to that many sequential
+                # units (parameter-change/RESET-broken groups cost
+                # ceil(units / unit_unroll) rounds, not one round per
+                # unit).  A fori_loop (not a Python unroll) keeps the
+                # compiled graph one sub_step big, and its cond skips
+                # finished sub-steps so a batch whose units are
+                # exhausted early (the uniform-herd one-unit case) pays
+                # for one.
+                sc = lax.fori_loop(
+                    0, max(1, unit_unroll),
+                    lambda _k, c: lax.cond(
+                        jnp.all(c[0]), lambda cc: cc,
+                        lambda cc: sub_step(*cc), c,
+                    ),
+                    sc,
+                )
+                applied, g, resp, last_head = sc
+                # One scatter per slot, from its LAST applied head this
+                # round — that row's ``g`` carries the slot's final
+                # chained state (heads are boundary rows of the final
+                # propagation, so their own values survive in ``g``).
+                seg_last = _seg_max_all(is_start, last_head)
+                scat_src = (last_head >= 0) & (last_head == seg_last)
+                scat = jnp.where(scat_src, reqs.slot, capacity)
+                st = _scatter(st, scat, g)
+                return applied, st, resp
 
             _, st, resp = lax.while_loop(
                 cond, body, (~reqs.valid, state, resp0)
@@ -1780,16 +1845,26 @@ class TickEngine:
         zeros, _, _ = _layout_ops(self.layout)
         with jax.default_device(self.device):
             self.state = jax.tree.map(jnp.asarray, zeros(self.capacity))
-        self._tick = _jitted_tick(self.capacity, self.layout,
-                                  sorted_input=True, compact_resp=True,
-                                  compact_req=True)
-        # Unique-slot batches (no duplicate keys after the host sort) run
-        # the parts-native program: pure int32/f32, no XLA 64-bit
-        # emulation, Pallas-fusable (ops/tick32.py).
+        # Mixed/ineligible duplicate batches run the parts-native chained
+        # unit-round program (tick32.make_sorted_tick32_rows_fn): exact
+        # per-slot order, ceil(units/8) gather+scatter rounds, no XLA
+        # 64-bit emulation.  GUBER_TPU_SORTED32=0 falls back to the x64
+        # oracle program (engine.make_tick_fn), which stays the parity
+        # reference in tests.
+        import os as _os
+
         from gubernator_tpu.ops.tick32 import (
             jitted_merged_pipeline,
+            jitted_sorted_tick32,
             jitted_tick32,
         )
+
+        if _os.environ.get("GUBER_TPU_SORTED32") == "0":
+            self._tick = _jitted_tick(self.capacity, self.layout,
+                                      sorted_input=True, compact_resp=True,
+                                      compact_req=True)
+        else:
+            self._tick = jitted_sorted_tick32(self.capacity, self.layout)
 
         self._tick32 = jitted_tick32(self.capacity, self.layout)
         # Grouped batches (uniform duplicate groups — Zipf/hot-key

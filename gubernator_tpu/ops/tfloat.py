@@ -191,6 +191,17 @@ def floor_to_pair(t: T3) -> p64.I64:
     return p64.select(too_low, p64.add(cand, one), cand)
 
 
+def trunc_to_pair(t: T3) -> p64.I64:
+    """trunc(t) toward zero as an i64 pair — Go's ``int64(float64)``
+    conversion (algorithms.go:377 ``int64(rate)``).  Equal to floor for
+    t >= 0; one above floor for negative non-integers (a negative leaky
+    rate from a negative duration is the one engine input where the two
+    differ)."""
+    fl = floor_to_pair(t)
+    neg_frac = ~ge_zero(t) & gt_zero(sub(t, from_pair(fl)))
+    return p64.select(neg_frac, p64.add(fl, p64.const(1, t.hi)), fl)
+
+
 def ge_zero(t: T3):
     """t >= 0 for a renormalized triple (sign of leading nonzero part)."""
     return (t.hi > 0) | (

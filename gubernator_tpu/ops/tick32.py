@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from gubernator_tpu.ops import i64pair as p64
+from gubernator_tpu.types import Behavior
 from gubernator_tpu.ops.transition32 import (
     preq_from_compact,
     presp_to_compact,
@@ -237,6 +238,221 @@ def make_merged_tick32_rows_fn(capacity: int, layout: str = "columns"):
             scat = jnp.where(r.valid, r.slot, jnp.int32(capacity))
             state = pstate_scatter_columns(state, scat, folded)
             return state, rows
+
+    return tick
+
+
+# ----------------------------------------------------------------------
+# Sorted mixed-duplicate tick: chained unit rounds, parts-native
+# ----------------------------------------------------------------------
+def make_sorted_tick32_rows_fn(capacity: int, layout: str = "columns",
+                               unit_unroll: int = 8):
+    """The mixed-duplicate program, parts-native: (state, m32 (19, B)
+    slot-sorted compact requests, now) → (state, 6-row compact response
+    tuple), preserving exact per-slot request order.
+
+    Structure (the engine.make_tick_fn tick_sorted contract, restated in
+    int32/f32 parts so no XLA 64-bit emulation rides the mixed-herd
+    path):
+
+    * a *unit* is a maximal run of identical fold-eligible duplicates
+      (engine._sorted_merge_plan); uniform groups are one unit, groups
+      broken by RESET/Gregorian/query/parameter-change rows are several;
+    * each round gathers once, then applies up to ``unit_unroll`` units
+      per slot IN REGISTERS — head transition (transition32), follower
+      fold (merged_fold32 + _expand_members, the grouped program's own
+      closed forms), then forward-propagates the folded row state so the
+      next unit's head chains without a scatter/gather round trip — and
+      scatters once, from each slot's last applied head;
+    * cost: ceil(units / unit_unroll) gather+scatter rounds, with
+      sequential unit transitions amortized onto cheap elementwise work
+      (the Go reference serializes the same traffic per key,
+      workers.go:190-258; here the chain rides the VPU).
+    """
+    from gubernator_tpu.ops.transition32 import (
+        _expand_members, merged_fold32)
+
+    if layout == "row":
+        from gubernator_tpu.ops.rowtable import gather_rows, scatter_rows
+
+        def gather_mat(state, slots):
+            return gather_rows(state.table, slots)
+
+        def scatter_mat(state, scat, mat):
+            return state._replace(
+                table=scatter_rows(state.table, scat, mat))
+    else:
+
+        def gather_mat(state, slots):
+            return pstate_to_matrix(pstate_gather_columns(state, slots))
+
+        def scatter_mat(state, scat, mat):
+            return pstate_scatter_columns(
+                state, scat, pstate_from_matrix(mat))
+
+    def tick(state, m32, now):
+        from gubernator_tpu.ops.engine import (
+            REQ32_INDEX as R,
+            _seg_max_all,
+            _seg_min_all,
+        )
+
+        b = m32.shape[1]
+        idx = jnp.arange(b, dtype=I32)
+        rq = preq_from_compact(m32)
+        np_ = now_to_pair(now)
+        slot = rq.slot
+        slots_clip = jnp.clip(slot, 0, capacity - 1)
+        key = jnp.where(rq.valid, slot, jnp.int32(capacity))
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), key[1:] != key[:-1]])
+
+        # Unit plan (engine._sorted_merge_plan on the compact matrix):
+        # "equals its predecessor" chains to "equals its head" within a
+        # contiguous run.
+        PARAM_ROWS = (
+            R["algorithm"], R["behavior"],
+            R["hits"], R["hits"] + 1,
+            R["limit"], R["limit"] + 1,
+            R["duration"], R["duration"] + 1,
+            R["created_at"], R["created_at"] + 1,
+            R["burst"], R["burst"] + 1,
+            R["greg_exp"], R["greg_exp"] + 1,
+            R["greg_dur"], R["greg_dur"] + 1,
+        )
+        eqp = jnp.ones(b - 1, jnp.bool_)
+        for row in PARAM_ROWS:
+            eqp = eqp & (m32[row, 1:] == m32[row, :-1])
+        same_as_prev = is_start | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), eqp])
+        NO_MERGE = jnp.int32(
+            int(Behavior.RESET_REMAINING)
+            | int(Behavior.DURATION_IS_GREGORIAN))
+        hits_pos = p64.gt(rq.hits, p64.const(0, slot))
+        ok = (
+            rq.valid & same_as_prev & hits_pos
+            & ((rq.behavior & NO_MERGE) == 0)
+            & (rq.known | is_start)
+        )
+        unit_start = is_start | ~ok
+        nxt = jnp.where(unit_start, idx, jnp.int32(b))
+        sfx = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
+        unit_end = jnp.concatenate(
+            [sfx[1:], jnp.full((1,), b, jnp.int32)])
+
+        resp0 = tuple(jnp.zeros(b, I32) for _ in range(6))
+        bmax = jnp.int32(b - 1)
+
+        def sub_step(applied, g_mat, resp, cur_head, last_head):
+            """One unit per slot, no scans: ``cur_head[i]`` points at the
+            head row the row's segment processes this sub-step (every
+            row of a segment shares the value), so all head→member data
+            flow is B-indexed gathers.  Rows whose pointer has walked
+            into a following segment are harmless: a head is always the
+            lowest-indexed live row of its unit, so the ``i > h`` fold
+            guard never matches across segments."""
+            cand = ~applied
+            head = cand & (idx == cur_head)
+            s = pstate_from_matrix(g_mat)
+            new_s, r_out = transition32(np_, s, rq)
+            cnt = jnp.where(head, unit_end - idx, jnp.int32(1))
+            folded, mh = merged_fold32(np_, new_s, rq, cnt)
+            head6 = _resp_rows(r_out)
+            folded_mat = pstate_to_matrix(folded)
+
+            h = cur_head  # (B,) row index of my segment's current head
+            def hv(a):
+                return a[h]
+
+            hpos = h
+            uend = hv(unit_end)
+            base = p64.I64(hv(mh.base.lo), hv(mh.base.hi))
+            q = p64.I64(hv(mh.q.lo), hv(mh.q.hi))
+            rate_i = p64.I64(hv(mh.rate_i.lo), hv(mh.rate_i.hi))
+            s0 = hv(mh.s0)
+            expire = p64.I64(hv(mh.expire.lo), hv(mh.expire.hi))
+            head6_p = tuple(hv(r6) for r6 in head6)
+            head_live = hv(head)  # my segment fired a head this sub-step
+
+            rank = idx - hpos
+            alive = p64.le(np_, expire)
+            fold = (cand & ok & head_live & alive
+                    & (rank > 0) & (idx < uend))
+            member6 = _expand_members(
+                head6_p, base=base, q=q, rate_i=rate_i, s0=s0,
+                expire=expire, h=rq.hits, limit=rq.limit,
+                created=rq.created_at, algorithm=rq.algorithm,
+                behavior=rq.behavior, rank=rank,
+            )
+            upd = head | fold
+            resp = tuple(
+                jnp.where(upd, mv, rv) for rv, mv in zip(resp, member6)
+            )
+            # Chain: every row's working state becomes its segment
+            # head's unit-final state (only rows that head the NEXT
+            # sub-step consume it, so over-sharing is free and simple).
+            g_mat = jnp.where(
+                head_live[:, None], folded_mat[h], g_mat)
+            applied = applied | head | fold
+            last_head = jnp.where(head, idx, last_head)
+            # Advance the pointer: a live fold consumed the whole unit
+            # (next head = unit end); a dead head consumed only itself.
+            nxt_h = jnp.where(
+                head_live,
+                jnp.minimum(
+                    jnp.where(alive, uend, hpos + 1), bmax),
+                cur_head,
+            )
+            return applied, g_mat, resp, nxt_h, last_head
+
+        def round_body(carry):
+            applied, state, resp = carry
+            g_mat = gather_mat(state, slots_clip)
+            cand0 = ~applied
+            # One segmented min per ROUND seeds the head pointers; the
+            # sub-steps advance them with gathers only.
+            first_cand = _seg_min_all(
+                is_start, jnp.where(cand0, idx, jnp.int32(b)))
+            cur_head = jnp.minimum(first_cand, bmax)
+            sc = (applied, g_mat, resp, cur_head, jnp.full(b, -1, I32))
+            sc = jax.lax.fori_loop(
+                0, max(1, unit_unroll),
+                lambda _k, c: jax.lax.cond(
+                    jnp.all(c[0]), lambda cc: cc,
+                    lambda cc: sub_step(*cc), c,
+                ),
+                sc,
+            )
+            applied, g_mat, resp, cur_head, last_head = sc
+            seg_last = _seg_max_all(is_start, last_head)
+            scat_src = (last_head >= 0) & (last_head == seg_last)
+            scat = jnp.where(scat_src, slot, jnp.int32(capacity))
+            state = scatter_mat(state, scat, g_mat)
+            return applied, state, resp
+
+        applied0 = ~rq.valid
+        _, state, resp = jax.lax.while_loop(
+            lambda c: ~jnp.all(c[0]), round_body,
+            (applied0, state, resp0),
+        )
+        return state, resp
+
+    return tick
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_sorted_tick32(capacity: int, layout: str = "columns",
+                         unit_unroll: int = 8):
+    """Engine entry for mixed-duplicate batches: two-program composition
+    (rows + stack), like jitted_tick32."""
+    inner = jax.jit(
+        make_sorted_tick32_rows_fn(capacity, layout, unit_unroll),
+        donate_argnums=(0,))
+    stack = _jitted_stack6()
+
+    def tick(state, m32, now):
+        state, rows = inner(state, m32, now)
+        return state, stack(rows)
 
     return tick
 

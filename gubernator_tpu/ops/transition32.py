@@ -191,7 +191,10 @@ def transition32(now: I64, s: PState, r: PReq) -> tuple[PState, PResp]:
         tf.ge_pair(b_rem2, p64.add(burst, one)), tf.from_pair(burst), b_rem2)
 
     rem_i = tf.floor_to_pair(b_rem3)
-    rate_i = tf.floor_to_pair(rate)
+    # Go converts the float rate with int64(rate) — trunc toward zero,
+    # which differs from floor when a negative duration makes the rate
+    # negative (algorithms.go:336,377).
+    rate_i = tf.trunc_to_pair(rate)
     l_at_zero = p64.is_zero(rem_i) & h_pos
     l_exact = ~l_at_zero & p64.eq(rem_i, h)
     l_over = ~l_at_zero & ~l_exact & p64.gt(h, rem_i)
@@ -224,7 +227,8 @@ def transition32(now: I64, s: PState, r: PReq) -> tuple[PState, PResp]:
     le_expire = p64.select(
         ~h_query, p64.add(r.created_at, duration_eff), s.expire_at)
 
-    ln_rate_i = tf.floor_to_pair(tf.div(tf.from_pair(r.duration), safe_limit_t))
+    ln_rate_i = tf.trunc_to_pair(
+        tf.div(tf.from_pair(r.duration), safe_limit_t))
     ln_duration = p64.select(greg_b, p64.sub(r.greg_exp, now), r.duration)
     ln_over = p64.gt(h, burst)
     ln_remf = tf.select(

@@ -72,14 +72,21 @@ def populate(rng, tick, state, b, rounds=3):
     return state
 
 
-@pytest.mark.parametrize("seed,b", [(1, 128), (2, 256)])
-def test_fused_matches_unfused(seed, b):
-    """Small chunk (32) forces the double-buffered pipelined path (nc =
-    4/8) without interpret-mode minutes."""
+# Small chunks force the double-buffered pipelined path (nc >= 2)
+# without production-width batches.  Mosaic (real TPU) requires the
+# chunk to be lane-aligned (128); interpret mode keeps 32 so the
+# Python-stepped DMA loop stays seconds, not minutes.
+SMALL_CHUNK = 128 if jax.default_backend() == "tpu" else 32
+
+
+@pytest.mark.parametrize("seed,mult", [(1, 4), (2, 8)])
+def test_fused_matches_unfused(seed, mult):
+    """nc = 4/8 chunks exercises the double-buffered pipelined path."""
     from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
 
+    b = SMALL_CHUNK * mult
     rng = np.random.default_rng(seed)
-    fused = jax.jit(make_fused_tick_fn(CAP, chunk=32))
+    fused = jax.jit(make_fused_tick_fn(CAP, chunk=SMALL_CHUNK))
     plain = make_plain(CAP)
 
     state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
@@ -104,8 +111,8 @@ def test_fused_matches_merge_program_on_unique():
     from gubernator_tpu.ops.fusedtick import make_fused_tick_fn
 
     rng = np.random.default_rng(7)
-    b = 128
-    fused = jax.jit(make_fused_tick_fn(CAP, chunk=32))
+    b = 4 * SMALL_CHUNK
+    fused = jax.jit(make_fused_tick_fn(CAP, chunk=SMALL_CHUNK))
     legacy = _jitted_tick(CAP, "row", sorted_input=True,
                           compact_resp=True, compact_req=True)
 
@@ -151,8 +158,8 @@ def test_fused_merged_matches_xla_merged():
     from gubernator_tpu.ops.tick32 import make_merged_tick32_rows_fn
 
     rng = np.random.default_rng(21)
-    b = 128
-    fused = jax.jit(make_fused_merged_tick_fn(CAP, chunk=32))
+    b = 4 * SMALL_CHUNK
+    fused = jax.jit(make_fused_merged_tick_fn(CAP, chunk=SMALL_CHUNK))
     inner = jax.jit(make_merged_tick32_rows_fn(CAP, "row"))
 
     def plain(state, mhead, count, now):
