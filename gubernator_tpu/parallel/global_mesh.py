@@ -47,22 +47,28 @@ table; the winner across nodes is picked with a ``pmax`` over write stamps.
 gRPC remains the reconciliation transport only *across* meshes (separate
 clusters / DCs) — within a mesh no RPC is issued at all.
 
-**Scaling envelope (read before raising ``capacity``).**  A reconcile is
-*dense*: it all-gathers the (3, capacity) accumulators plus the per-node
-authoritative slices and applies ``bucket_transition`` to every slot —
-O(capacity · n_nodes) device work and O(capacity · n_nodes · 8 B) ICI
-traffic per step, independent of how many slots were actually hit.  Each
-node also holds a full replica (~100 B/slot).  That trade is deliberate:
-at the GLOBAL keyspace the reference sustains (its defaults cap the whole
-cache at 50K items, config.go:139) a dense 64K-slot reconcile is ~25 MB
-of collective traffic every 100 ms — microseconds of a v5e ICI's
-~10 GB/s/link — and the dense form needs no gather/scatter or
-host-driven sparsity bookkeeping.  It does NOT extend to tables near the
-serving table's 10M–100M scale: at 10M slots a step would move ~4 GB over
-ICI and rewrite the full replica per node.  GLOBAL limits are a small,
-hot subset of the keyspace (the reference's design assumption too);
-keep ``capacity`` in the 2^14–2^20 range, and shard the *serving* table
-(mesh_engine.py) — not this one — for bulk keyspace scale.
+**Scaling envelope (read before raising ``capacity``).**  The DENSE
+reconcile all-gathers the (ACC_ROWS, capacity) accumulators plus the
+per-node authoritative slices and applies ``bucket_transition`` to every
+slot — O(capacity · n_nodes) device work and ICI traffic per step,
+independent of how many slots were actually hit.  That form is the
+default up to 2^16 slots: one fused pass, no sparsity bookkeeping, and
+at the reference's GLOBAL keyspace (its defaults cap the whole cache at
+50K items, config.go:139) a dense 64K-slot reconcile is ~25 MB of
+collective traffic every 100 ms — microseconds of a v5e ICI's
+~10 GB/s/link.
+
+Past that, the SPARSE reconcile takes over (``sparse_k`` envelope,
+auto-enabled above 2^16 slots): each node compacts its hit window and
+touched-slot set device-side, the collectives move those envelopes —
+O(hits · n_nodes) ICI bytes — owners apply the windows to their
+authoritative rows with K-row gather/scatter, and only changed rows
+re-broadcast.  Reconcile cost then scales with traffic, not table size,
+lifting the envelope to multi-million-slot GLOBAL tables (hard cap
+2^24); a step that overflows the envelope falls back to the dense pass
+in-program, so the envelope is a performance knob, never a correctness
+one.  Each node still holds a full replica (~100 B/slot) — HBM, not
+ICI, bounds capacity.
 """
 
 from __future__ import annotations
@@ -120,7 +126,12 @@ AUX_ROWS = (
 AUX = {name: i for i, name in enumerate(AUX_ROWS)}
 
 # Accumulator rows (global.go:99-112's per-key aggregation, as dense arrays).
-ACC_HITS, ACC_RESET, ACC_COUNT = 0, 1, 2
+# ACC_TOUCH counts EVERY local application (owned, non-owned, queries):
+# the sparse reconcile derives its restore/re-broadcast sets from it —
+# which replica rows diverged provisionally, which owned rows were
+# written directly.
+ACC_HITS, ACC_RESET, ACC_COUNT, ACC_TOUCH = 0, 1, 2, 3
+ACC_ROWS = 4
 
 
 def make_global_mesh(n_nodes: Optional[int] = None,
@@ -206,10 +217,12 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
         queue = r.valid & ~owned & (r.hits != 0)
         qslot = jnp.where(queue, r.slot, capacity)
         reset = queue & ((r.behavior & Behavior.RESET_REMAINING) != 0)
+        tslot = jnp.where(r.valid, r.slot, capacity)
         acc = jnp.stack([
             acc[ACC_HITS].at[qslot].add(jnp.where(queue, r.hits, 0), mode="drop"),
             acc[ACC_RESET].at[qslot].add(reset.astype(I64), mode="drop"),
             acc[ACC_COUNT].at[qslot].add(queue.astype(I64), mode="drop"),
+            acc[ACC_TOUCH].at[tslot].add(r.valid.astype(I64), mode="drop"),
         ])
 
         packed = jnp.stack([
@@ -235,8 +248,68 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
     )
 
 
+def _make_compact(capacity: int):
+    """Compactor: first ``width`` set slots of a mask (slot order), padded
+    with ``capacity``; overflow rows drop (the overflow probe rejects
+    such steps before sparse results are used)."""
+    def compact(mask, width):
+        arange_c = jnp.arange(capacity, dtype=I32)
+        rank = jnp.cumsum(mask.astype(I32)) - 1
+        tgt = jnp.where(mask & (rank < width), rank, width)
+        return jnp.full(width + 1, capacity, I32).at[tgt].set(
+            arange_c, mode="drop")[:width]
+
+    return compact
+
+
+def make_global_overflow_fn(mesh: Mesh, capacity: int, n_nodes: int,
+                            sparse_k: int):
+    """Envelope probe for the sparse reconcile: (accum) → replicated
+    bool, True when this step's windows, touch sets, or any owner's
+    re-broadcast share exceed the sparse envelopes — the caller then
+    runs the dense program instead (host dispatch; see
+    make_global_reconcile_fn)."""
+    slice_sz = capacity // n_nodes
+    K, K2 = int(sparse_k), 2 * int(sparse_k)
+
+    def _probe(accum_blk):
+        my = lax.axis_index("node")
+        acc_me = accum_blk[0]
+        owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
+        compact = _make_compact(capacity)
+
+        def gather_rows(x):
+            buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
+            return lax.psum(buf, "node")
+
+        wmask = acc_me[ACC_COUNT] > 0
+        tmask = acc_me[ACC_TOUCH] > 0
+        counts = gather_rows(jnp.stack([
+            jnp.count_nonzero(wmask), jnp.count_nonzero(tmask)]))
+        all_w = gather_rows(jnp.stack([
+            compact(wmask, K), compact(tmask, K)]))   # (n, 2, K)
+        touched = jnp.zeros(capacity, jnp.bool_)
+
+        def mark(d, m):
+            m = m.at[all_w[d, 0]].set(True, mode="drop")
+            return m.at[all_w[d, 1]].set(True, mode="drop")
+
+        touched = lax.fori_loop(0, n_nodes, mark, touched)
+        bcounts = gather_rows(jnp.count_nonzero(touched & owned))
+        return (jnp.max(counts) > K) | (jnp.max(bcounts) > K2)
+
+    return jax.shard_map(
+        _probe,
+        mesh=mesh,
+        in_specs=(P("node", None, None),),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
 def make_global_reconcile_fn(
-    mesh: Mesh, capacity: int, n_nodes: int, strict_sequencing: bool = True
+    mesh: Mesh, capacity: int, n_nodes: int, strict_sequencing: bool = True,
+    sparse_k: int = 0,
 ):
     """The collective reconcile step: aggregate hits + replicate authority.
 
@@ -250,6 +323,28 @@ def make_global_reconcile_fn(
     sensitive).  The non-strict path folds all nodes into one psum and a
     single application — one dense pass instead of ``n_nodes``, for
     deployments that accept aggregate-application semantics.
+
+    ``sparse_k > 0`` returns the SPARSE step instead: every node
+    compacts its hit window and its touched-slot set to a
+    ``sparse_k``-row envelope, the collectives move those envelopes
+    instead of full tables — O(hits · n) ICI bytes and gather/scatter
+    work instead of O(capacity · n) — owners apply the gathered windows
+    to their authoritative rows only, and re-broadcast just the
+    changed/touched rows (2·sparse_k envelope).  This is what lifts the
+    dense form's ~2^20-slot envelope (module docstring) to
+    multi-million-slot GLOBAL tables.  The sparse program ASSUMES no
+    envelope overflow; callers consult :func:`make_global_overflow_fn`
+    first and run the dense program for the rare overflowing step (host
+    dispatch, not an in-program cond: a cond would copy the whole
+    untouched table through its output buffer and re-impose the
+    O(capacity) cost the sparse step removes).  The reference ships only
+    touched keys the same way (global.go:91-140).
+
+    Sparse parameter semantics are per-window (each node's aggregated
+    window applies with ITS OWN latest-request params, like each peer's
+    GetPeerRateLimits RPC carrying its own request protos) — the dense
+    path's cross-node stamp winner is a superset that can also resurrect
+    params from nodes with no hits this window; the reference does not.
     """
     slice_sz = capacity // n_nodes
 
@@ -261,107 +356,222 @@ def make_global_reconcile_fn(
         # one-hot-row buffers; broadcast as an ownership-masked psum.
         my = lax.axis_index("node")
         rep = jax.tree.map(lambda a: a[0], state_blk)
+        aux = aux_blk[0]
+        acc_me = accum_blk[0]
 
-        # broadcastPeers as a collective: every node contributes its owned
-        # (authoritative) slice, masked psum reassembles the full table in
-        # slot order on every node — replicas are now the authoritative
-        # state, exactly what UpdatePeerGlobals installs
-        # (gubernator.go:425-459).
         owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
-
-        def bcast(a):
-            if a.dtype == jnp.bool_:
-                return lax.psum(
-                    jnp.where(owned, a, False).astype(I32), "node"
-                ) > 0
-            return lax.psum(jnp.where(owned, a, jnp.zeros((), a.dtype)), "node")
-
-        # Stored-layout broadcast (the masked psum is exact on bitcast i32
-        # halves: exactly one node contributes per slot), then a logical
-        # view for the dense transition below.
-        base = logical_view(jax.tree.map(bcast, rep))
 
         def gather_rows(x):
             """all_gather x over 'node' via one-hot psum → (n_nodes, *x.shape)."""
             buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
             return lax.psum(buf, "node")
 
-        # Latest request parameters across nodes: max over write stamps
-        # (ties broken by node index), then a masked psum selects the
-        # winner's aux row — the aggregated request proto of global.go:99-112.
-        aux = aux_blk[0]
-        stamp = aux[AUX["stamp"]]
-        key = jnp.where(
-            stamp > 0, stamp * n_nodes + my.astype(I64), jnp.int64(-1)
-        )
-        win = jnp.max(gather_rows(key), axis=0)
-        mine = (key == win) & (win >= 0)
-        params = lax.psum(jnp.where(mine[None, :], aux, 0), "node")
-        havep = win >= 0
+        def dense_recon(_):
+            # broadcastPeers as a collective: every node contributes its
+            # owned (authoritative) slice, masked psum reassembles the full
+            # table in slot order on every node — replicas are now the
+            # authoritative state, exactly what UpdatePeerGlobals installs
+            # (gubernator.go:425-459).
+            def bcast(a):
+                if a.dtype == jnp.bool_:
+                    return lax.psum(
+                        jnp.where(owned, a, False).astype(I32), "node"
+                    ) > 0
+                return lax.psum(
+                    jnp.where(owned, a, jnp.zeros((), a.dtype)), "node")
 
-        # Forwarded GLOBAL hits get DRAIN_OVER_LIMIT forced
-        # (gubernator.go:510-512); RESET_REMAINING applies iff queued this
-        # window (stale RESET bits in aux must not re-fire).
-        base_behavior = jnp.where(havep, params[AUX["behavior"]], 0).astype(I32)
-        base_behavior = base_behavior & ~jnp.int32(Behavior.RESET_REMAINING)
-        base_behavior = base_behavior | jnp.int32(Behavior.DRAIN_OVER_LIMIT)
+            # Stored-layout broadcast (the masked psum is exact on bitcast
+            # i32 halves: exactly one node contributes per slot), then a
+            # logical view for the dense transition below.
+            base = logical_view(jax.tree.map(bcast, rep))
 
-        def make_req(hits, reset, valid):
-            return ReqBatch(
-                slot=jnp.arange(capacity, dtype=I32),
-                known=jnp.ones(capacity, jnp.bool_),
-                hits=hits,
-                limit=jnp.where(havep, params[AUX["limit"]], base.limit),
-                duration=jnp.where(
-                    havep, params[AUX["duration"]], base.duration
-                ),
-                algorithm=jnp.where(
-                    havep, params[AUX["algorithm"]], base.algorithm.astype(I64)
-                ).astype(I32),
-                behavior=jnp.where(
-                    reset > 0,
-                    base_behavior | jnp.int32(Behavior.RESET_REMAINING),
-                    base_behavior,
-                ),
-                created_at=jnp.where(havep, params[AUX["created_at"]], now),
-                burst=jnp.where(havep, params[AUX["burst"]], base.burst),
-                greg_exp=params[AUX["greg_exp"]],
-                greg_dur=params[AUX["greg_dur"]],
-                valid=valid,
+            # Latest request parameters across nodes: max over write
+            # stamps (ties broken by node index), then a masked psum
+            # selects the winner's aux row — the aggregated request proto
+            # of global.go:99-112.
+            stamp = aux[AUX["stamp"]]
+            key = jnp.where(
+                stamp > 0, stamp * n_nodes + my.astype(I64), jnp.int64(-1)
             )
+            win = jnp.max(gather_rows(key), axis=0)
+            mine = (key == win) & (win >= 0)
+            params = lax.psum(jnp.where(mine[None, :], aux, 0), "node")
+            havep = win >= 0
 
-        def apply(st, hits, reset, valid):
-            # Dense application: slot i ↔ request i — no gather/scatter, no
-            # rank rounds; the whole table updates in one elementwise pass.
-            new_state, _ = bucket_transition(
-                now, st, make_req(hits, reset, valid)
-            )
-            return jax.tree.map(
-                lambda n, b: jnp.where(valid, n, b), new_state, st
-            )
+            # Forwarded GLOBAL hits get DRAIN_OVER_LIMIT forced
+            # (gubernator.go:510-512); RESET_REMAINING applies iff queued
+            # this window (stale RESET bits in aux must not re-fire).
+            base_behavior = jnp.where(
+                havep, params[AUX["behavior"]], 0).astype(I32)
+            base_behavior = base_behavior & ~jnp.int32(
+                Behavior.RESET_REMAINING)
+            base_behavior = base_behavior | jnp.int32(
+                Behavior.DRAIN_OVER_LIMIT)
 
-        if strict_sequencing:
-            # sendHits, exactly: every node's window is one batch at the
-            # authority, applied in node order (all_gather + on-device fold).
-            acc_all = gather_rows(accum_blk[0])  # (n, 3, capacity)
-
-            def fold(d, st):
-                return apply(
-                    st,
-                    acc_all[d, ACC_HITS],
-                    acc_all[d, ACC_RESET],
-                    acc_all[d, ACC_COUNT] > 0,
+            def make_req(hits, reset, valid):
+                return ReqBatch(
+                    slot=jnp.arange(capacity, dtype=I32),
+                    known=jnp.ones(capacity, jnp.bool_),
+                    hits=hits,
+                    limit=jnp.where(havep, params[AUX["limit"]], base.limit),
+                    duration=jnp.where(
+                        havep, params[AUX["duration"]], base.duration
+                    ),
+                    algorithm=jnp.where(
+                        havep, params[AUX["algorithm"]],
+                        base.algorithm.astype(I64)
+                    ).astype(I32),
+                    behavior=jnp.where(
+                        reset > 0,
+                        base_behavior | jnp.int32(Behavior.RESET_REMAINING),
+                        base_behavior,
+                    ),
+                    created_at=jnp.where(
+                        havep, params[AUX["created_at"]], now),
+                    burst=jnp.where(havep, params[AUX["burst"]], base.burst),
+                    greg_exp=params[AUX["greg_exp"]],
+                    greg_dur=params[AUX["greg_dur"]],
+                    valid=valid,
                 )
 
-            merged = lax.fori_loop(0, n_nodes, fold, base)
-        else:
-            # sendHits as one reduction: cluster-total hits per slot.
-            acc = lax.psum(accum_blk[0], "node")
-            merged = apply(
-                base, acc[ACC_HITS], acc[ACC_RESET], acc[ACC_COUNT] > 0
+            def apply(st, hits, reset, valid):
+                # Dense application: slot i ↔ request i — no gather/
+                # scatter, no rank rounds; the whole table updates in one
+                # elementwise pass.
+                new_state, _ = bucket_transition(
+                    now, st, make_req(hits, reset, valid)
+                )
+                return jax.tree.map(
+                    lambda n, b: jnp.where(valid, n, b), new_state, st
+                )
+
+            if strict_sequencing:
+                # sendHits, exactly: every node's window is one batch at
+                # the authority, applied in node order (all_gather +
+                # on-device fold).
+                acc_all = gather_rows(acc_me)  # (n, ACC_ROWS, capacity)
+
+                def fold(d, st):
+                    return apply(
+                        st,
+                        acc_all[d, ACC_HITS],
+                        acc_all[d, ACC_RESET],
+                        acc_all[d, ACC_COUNT] > 0,
+                    )
+
+                merged = lax.fori_loop(0, n_nodes, fold, base)
+            else:
+                # sendHits as one reduction: cluster-total hits per slot.
+                acc = lax.psum(acc_me, "node")
+                merged = apply(
+                    base, acc[ACC_HITS], acc[ACC_RESET], acc[ACC_COUNT] > 0
+                )
+            return stored_view(merged)
+
+        if not sparse_k:
+            merged = dense_recon(None)
+            return (
+                jax.tree.map(lambda a: a[None], merged),
+                jnp.zeros_like(accum_blk),
             )
+
+        # ------------------------------------------------------------------
+        # Sparse step: compact → gather envelopes → owner-apply → re-
+        # broadcast changed rows.  Compaction is device-local O(capacity)
+        # elementwise; everything crossing ICI is O(sparse_k · n).
+        # ------------------------------------------------------------------
+        K = int(sparse_k)
+        K2 = 2 * K
+        compact = _make_compact(capacity)
+
+        wmask = acc_me[ACC_COUNT] > 0          # my queued-hit window
+        tmask = acc_me[ACC_TOUCH] > 0          # every slot I wrote locally
+        wslots = compact(wmask, K)
+        tslots = compact(tmask, K)
+
+        wsl = jnp.clip(wslots, 0, capacity - 1)
+        payload = jnp.concatenate([
+            wslots.astype(I64)[None],
+            acc_me[ACC_HITS][wsl][None],
+            acc_me[ACC_RESET][wsl][None],
+            acc_me[ACC_COUNT][wsl][None],
+            aux[:, wsl],
+        ])                                      # (4 + len(AUX_ROWS), K)
+
+        def sparse_recon(_):
+            W = gather_rows(payload)            # (n, 13, K)
+            T = gather_rows(tslots)             # (n, K)
+
+            # sendHits at the authority: fold each node's window into MY
+            # owned rows, node order (strict semantics; the non-strict
+            # psum variant would lose per-window params, so sparse always
+            # sequences — window widths are small by construction).
+            def fold(d, st):
+                slots_d = W[d, 0].astype(I32)
+                sl = jnp.clip(slots_d, 0, capacity - 1)
+                ok = (slots_d < capacity) & owned[sl] & (W[d, 3] > 0)
+                auxd = W[d, 4:]
+                havep = auxd[AUX["stamp"]] > 0
+                gathered = gather_state(st, sl)
+                beh = jnp.where(havep, auxd[AUX["behavior"]], 0).astype(I32)
+                beh = beh & ~jnp.int32(Behavior.RESET_REMAINING)
+                beh = beh | jnp.int32(Behavior.DRAIN_OVER_LIMIT)
+                req = ReqBatch(
+                    slot=sl,
+                    known=jnp.ones(K, jnp.bool_),
+                    hits=W[d, 1],
+                    limit=jnp.where(
+                        havep, auxd[AUX["limit"]], gathered.limit),
+                    duration=jnp.where(
+                        havep, auxd[AUX["duration"]], gathered.duration),
+                    algorithm=jnp.where(
+                        havep, auxd[AUX["algorithm"]],
+                        gathered.algorithm.astype(I64)).astype(I32),
+                    behavior=jnp.where(
+                        W[d, 2] > 0,
+                        beh | jnp.int32(Behavior.RESET_REMAINING), beh),
+                    created_at=jnp.where(
+                        havep, auxd[AUX["created_at"]], now),
+                    burst=jnp.where(
+                        havep, auxd[AUX["burst"]], gathered.burst),
+                    greg_exp=jnp.where(havep, auxd[AUX["greg_exp"]], 0),
+                    greg_dur=jnp.where(havep, auxd[AUX["greg_dur"]], 0),
+                    valid=ok,
+                )
+                new_g, _ = bucket_transition(now, gathered, req)
+                return scatter_state(
+                    st, jnp.where(ok, sl, capacity), new_g)
+
+            st = lax.fori_loop(0, n_nodes, fold, rep)
+
+            # broadcastPeers, sparse: my owned rows that changed (any
+            # node's window) or that any node provisionally wrote (its
+            # touch set) ship to every replica; receivers scatter them in.
+            touched = jnp.zeros(capacity, jnp.bool_)
+
+            def mark(d, m):
+                m = m.at[W[d, 0].astype(I32)].set(True, mode="drop")
+                return m.at[T[d]].set(True, mode="drop")
+
+            touched = lax.fori_loop(0, n_nodes, mark, touched)
+            bmask = touched & owned
+            bslots = compact(bmask, K2)
+            bsl = jnp.clip(bslots, 0, capacity - 1)
+            rows = gather_state(st, bsl)
+            BS = gather_rows(bslots)
+            BR = jax.tree.map(gather_rows, rows)
+
+            def install(d, st2):
+                sl2 = BS[d]
+                scat = jnp.where(sl2 < capacity, sl2, capacity)
+                return scatter_state(
+                    st2, scat, jax.tree.map(lambda a: a[d], BR))
+
+            return lax.fori_loop(0, n_nodes, install, st)
+
+        merged = sparse_recon(None)
         return (
-            jax.tree.map(lambda a: a[None], stored_view(merged)),
+            jax.tree.map(lambda a: a[None], merged),
             jnp.zeros_like(accum_blk),
         )
 
@@ -420,6 +630,7 @@ class MeshGlobalEngine:
         max_batch: int = 1024,
         min_reconcile_ms: int = 0,
         strict_sequencing: bool = True,
+        sparse_k: Optional[int] = None,
     ):
         from gubernator_tpu.config import validate_global_mesh_capacity
 
@@ -430,6 +641,14 @@ class MeshGlobalEngine:
         self.capacity = -(-int(capacity) // self.n_nodes) * self.n_nodes
         self.max_batch = int(max_batch)
         self.min_reconcile_ms = int(min_reconcile_ms)
+        # Sparse reconcile envelope: auto-on past the dense envelope's
+        # comfortable range (the dense step rewrites every slot on every
+        # node; see make_global_reconcile_fn).  Small tables keep the
+        # dense step — it is a single fused pass with no compaction
+        # bookkeeping and its ICI cost is negligible there.
+        if sparse_k is None:
+            sparse_k = 4096 if self.capacity > (1 << 16) else 0
+        self.sparse_k = min(int(sparse_k), self.capacity)
 
         row = NamedSharding(self.mesh, P("node", None))
         mat = NamedSharding(self.mesh, P("node", None, None))
@@ -443,18 +662,35 @@ class MeshGlobalEngine:
             jnp.zeros((self.n_nodes, len(AUX_ROWS), self.capacity), I64), mat
         )
         self.accum = jax.device_put(
-            jnp.zeros((self.n_nodes, 3, self.capacity), I64), mat
+            jnp.zeros((self.n_nodes, ACC_ROWS, self.capacity), I64), mat
         )
         self._proc = jax.jit(
             make_global_process_fn(self.mesh, self.capacity, self.n_nodes),
             donate_argnums=(0, 1, 2),
         )
-        self._recon = jax.jit(
+        self._recon_dense = jax.jit(
             make_global_reconcile_fn(
                 self.mesh, self.capacity, self.n_nodes, strict_sequencing
             ),
             donate_argnums=(0, 2),
         )
+        if self.sparse_k:
+            self._recon_sparse = jax.jit(
+                make_global_reconcile_fn(
+                    self.mesh, self.capacity, self.n_nodes,
+                    strict_sequencing, sparse_k=self.sparse_k,
+                ),
+                donate_argnums=(0, 2),
+            )
+            self._overflow = jax.jit(
+                make_global_overflow_fn(
+                    self.mesh, self.capacity, self.n_nodes, self.sparse_k
+                )
+            )
+        else:
+            self._recon_sparse = None
+            self._overflow = None
+        self.metric_dense_fallbacks = 0
         self._evict = jax.jit(
             make_global_evict_fn(self.mesh), donate_argnums=(0, 1, 2)
         )
@@ -476,9 +712,22 @@ class MeshGlobalEngine:
             jax.device_put(m, self._req_sharding), jnp.int64(0), jnp.int64(0),
         )
         np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
-        self.state, self.accum = self._recon(
-            self.state, self.aux, self.accum, jnp.int64(0)
-        )
+        if self._recon_sparse is not None:
+            np.asarray(self._overflow(self.accum))
+            self.state, self.accum = self._recon_sparse(
+                self.state, self.aux, self.accum, jnp.int64(0)
+            )
+            if self.capacity <= (1 << 20):
+                # Big tables leave the dense fallback to compile lazily on
+                # the first (rare) overflowing step; warming it would run
+                # a full O(capacity·n) pass at startup.
+                self.state, self.accum = self._recon_dense(
+                    self.state, self.aux, self.accum, jnp.int64(0)
+                )
+        else:
+            self.state, self.accum = self._recon_dense(
+                self.state, self.aux, self.accum, jnp.int64(0)
+            )
         # Pre-compile the reclaim dead-scan (see TickEngine._warmup).
         from gubernator_tpu.ops.engine import device_dead_mask
 
@@ -647,10 +896,22 @@ class MeshGlobalEngine:
     # The collective reconcile (GlobalSyncWait cadence)
     # ------------------------------------------------------------------
     def reconcile(self, now: Optional[int] = None) -> None:
-        """One psum + all_gather reconciliation step (see module doc)."""
+        """One psum + all_gather reconciliation step (see module doc).
+
+        With a sparse envelope configured, a tiny device probe decides
+        dense-vs-sparse per step HOST-side — an in-program cond would
+        copy the whole untouched table through the cond output and
+        re-impose the O(capacity) cost the sparse step exists to remove.
+        """
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
-            self.state, self.accum = self._recon(
+            recon = self._recon_dense
+            if self._recon_sparse is not None:
+                if bool(np.asarray(self._overflow(self.accum))):
+                    self.metric_dense_fallbacks += 1
+                else:
+                    recon = self._recon_sparse
+            self.state, self.accum = recon(
                 self.state, self.aux, self.accum, jnp.int64(now)
             )
             self._pending.clear()
