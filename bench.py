@@ -757,6 +757,33 @@ def rung_snapshot(engine, label):
     t0 = time.perf_counter()
     fresh.load_columns(snap, now=1_700_000_000_000)
     load_s = time.perf_counter() - t0
+    del snap
+
+    # Incremental export after a ~1%-of-table touch: a delta must move
+    # bytes proportional to the touched working set, not the table
+    # (store.go:49-65 OnChange trickle analog).
+    now = 1_700_000_000_000
+    rng = np.random.default_rng(13)
+    touch = max(1024, items // 100)
+    batch = 4096
+    from gubernator_tpu.ops.engine import resolve_ticks
+
+    pending = []
+    for start in range(0, touch, batch):
+        ids = rng.integers(0, max(items, 1), min(batch, touch - start))
+        pending.append(engine.submit_columns(
+            _cols(ids, 1_000_000, 3_600_000, None), now))
+        if len(pending) >= 16:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
+    t0 = time.perf_counter()
+    delta = engine.export_columns(dirty_only=True)
+    delta_s = time.perf_counter() - t0
+    delta_items = len(delta["key_offsets"]) - 1
+    delta_mb = getattr(engine, "last_export_stats", {}).get(
+        "d2h_bytes", delta_items * 80
+    ) / 1e6
     return {
         "rung": label,
         "items": items,
@@ -764,6 +791,12 @@ def rung_snapshot(engine, label):
         "export_d2h_mb": round(d2h_mb, 1),
         "export_mbps": round(d2h_mb / max(export_s, 1e-9), 2),
         "load_s": round(load_s, 2),
+        "delta_touched": touch,
+        "delta_items": delta_items,
+        "delta_export_s": round(delta_s, 2),
+        "delta_d2h_mb": round(delta_mb, 2),
+        # ~0.01 = the delta moved ~1% of the full export's bytes
+        "delta_vs_full_bytes": round(delta_mb / max(d2h_mb, 1e-9), 4),
     }
 
 

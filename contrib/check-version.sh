@@ -1,0 +1,42 @@
+#!/bin/sh
+# Check version consistency across the repo (the reference's
+# contrib/check-version.sh, adapted: the root `version` file is the
+# source of truth — this repo's history has no release tags to derive
+# it from).
+set -u
+cd "$(dirname "$0")/.."
+
+VERSION=$(sed -e 's/^v//' version)
+if [ -z "$VERSION" ]; then
+  echo "Unable to determine version from the version file." >&2
+  exit 1
+fi
+echo "Version file: $VERSION"
+RETCODE=0
+
+# Package source of truth (gubernator_tpu/version.py).
+PY_VERSION=$(sed -n 's/^VERSION = "\(.*\)"/\1/p' gubernator_tpu/version.py)
+if [ "$VERSION" != "$PY_VERSION" ]; then
+  echo "gubernator_tpu/version.py mismatch: $VERSION <=> $PY_VERSION" >&2
+  RETCODE=1
+else
+  echo 'gubernator_tpu/version.py OK'
+fi
+
+# Packaging metadata.
+TOML_VERSION=$(sed -n 's/^version = "\(.*\)"/\1/p' pyproject.toml)
+if [ "$VERSION" != "$TOML_VERSION" ]; then
+  echo "pyproject.toml mismatch: $VERSION <=> $TOML_VERSION" >&2
+  RETCODE=1
+else
+  echo 'pyproject.toml OK'
+fi
+
+# If release tags exist, they must agree too (reference behavior).
+TAG=$(git describe --tags "$(git rev-list --tags --max-count=1 2>/dev/null)" 2>/dev/null | sed -e 's/^v//')
+if [ -n "$TAG" ] && [ "$VERSION" != "$TAG" ]; then
+  echo "git tag mismatch: $VERSION <=> $TAG" >&2
+  RETCODE=1
+fi
+
+exit $RETCODE

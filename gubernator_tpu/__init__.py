@@ -68,7 +68,7 @@ from gubernator_tpu.types import (  # noqa: E402
     RateLimitResponse,
 )
 
-__version__ = "0.2.0"
+from gubernator_tpu.version import VERSION as __version__
 
 __all__ = [
     "Algorithm",

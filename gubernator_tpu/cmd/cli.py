@@ -65,7 +65,10 @@ async def run(args) -> None:
 
 
 def main(argv=None) -> int:
+    from gubernator_tpu.version import banner
+
     p = argparse.ArgumentParser(description="gubernator-tpu load generator")
+    p.add_argument("--version", action="version", version=banner())
     p.add_argument("--address", default="localhost:81")
     p.add_argument("--limits", type=int, default=2000,
                    help="number of distinct random rate limits")

@@ -27,6 +27,9 @@ async def run(config_file: str) -> None:
         )
     else:
         logging.basicConfig(level=level)
+    from gubernator_tpu.version import banner
+
+    logging.getLogger("gubernator").info("%s", banner())
     daemon = await spawn_daemon(conf)
     print("Ready", flush=True)  # readiness marker (client tests wait on it)
 
@@ -39,9 +42,12 @@ async def run(config_file: str) -> None:
 
 
 def main(argv=None) -> int:
+    from gubernator_tpu.version import banner
+
     p = argparse.ArgumentParser(description="gubernator-tpu rate-limit daemon")
     p.add_argument("-config", "--config", default="", help="path to a key=value config file")
     p.add_argument("-debug", "--debug", action="store_true", help="debug logging")
+    p.add_argument("-version", "--version", action="version", version=banner())
     args = p.parse_args(argv)
     if args.debug:
         import os

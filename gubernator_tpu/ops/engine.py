@@ -1888,6 +1888,13 @@ class TickEngine:
         self._readback = _jitted_readback(self.layout)
         self.slots = make_slot_map(self.capacity)
         self._last_access = np.zeros(self.capacity, np.int64)
+        # Slots mutated since the last export — the incremental snapshot's
+        # working set (export_columns(dirty_only=True)).  Marked at the
+        # three mutation sites (tick, GLOBAL install, snapshot restore);
+        # cleared by any export.  The reference's Store OnChange trickles
+        # per-request updates continuously (store.go:49-65); here the
+        # delta accumulates host-side and drains on the export cadence.
+        self._dirty = np.zeros(self.capacity, bool)
         # Slots assigned host-side but not yet written by a device tick; the
         # device's in_use lags for these, so reclamation must not treat them
         # as dead (or two live keys could share a slot within one tick).
@@ -2337,6 +2344,8 @@ class TickEngine:
                         self.state, jnp.asarray(packed), jnp.int64(now)
                     )
             self._pending.clear()
+            tick_slots = packed[REQ32_INDEX["slot"], :n]
+            self._dirty[tick_slots[tick_slots < self.capacity]] = True
             slots_req = (
                 packed[REQ32_INDEX["slot"], :n][inv].astype(np.int64)
                 if self.store is not None
@@ -2485,6 +2494,7 @@ class TickEngine:
                 )
             if not by_slot:
                 return
+            self._dirty[list(by_slot)] = True
             rows = list(by_slot.values())
             # Width-chunked like load_items: the row layout stages the
             # batch in VMEM, so one huge push must not compile one huge
@@ -2500,7 +2510,7 @@ class TickEngine:
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
     # ------------------------------------------------------------------
-    def export_columns(self) -> dict:
+    def export_columns(self, dirty_only: bool = False) -> dict:
         """Bulk snapshot: numpy columns + one key blob (the Loader v2
         format; see SNAP_FIELDS).  The reference streams items through a
         channel (store.go:69-78); the columnar analog of that stream is
@@ -2515,9 +2525,29 @@ class TickEngine:
         into a single word.  Typical cost: 44 B/item instead of the full
         table's 80 B/slot.  Chunks pipeline: while chunk i drains over
         the link, chunk i+1's gather/probe runs on device.
-        ``last_export_stats`` records what actually crossed."""
+        ``last_export_stats`` records what actually crossed.
+
+        ``dirty_only=True`` exports only the slots mutated since the
+        previous export (any kind): the incremental path — a delta moves
+        bytes proportional to the touched working set, not the table
+        (the reference's Store OnChange design trickles the same way,
+        store.go:49-65).  Deltas are ordinary (smaller) snapshots:
+        ``load_columns`` applies them as upserts, so delta files append
+        to a full baseline.  Removals are only partially reproduced:
+        TTL-expired rows fall out at load time via the expire_at filter
+        (like the reference's persisted-but-expired items), but an
+        unexpired LRU *eviction* is not represented — a baseline+delta
+        restore can resurrect keys the source engine evicted to make
+        room.  That matches upsert-trickle semantics (the reference's
+        OnChange stream carries no deletions either, store.go:49-65);
+        restores needing eviction fidelity should take a full export.
+        Every export (full or delta) resets the dirty set."""
         with self._lock:
-            mapped = np.flatnonzero(self.slots.mapped_mask())
+            mask = self.slots.mapped_mask()
+            if dirty_only:
+                mask &= self._dirty
+            mapped = np.flatnonzero(mask)
+            self._dirty[:] = False
             n = len(mapped)
             empty = {
                 "key_blob": b"",
@@ -2530,7 +2560,8 @@ class TickEngine:
                 },
             }
             if n == 0:
-                self.last_export_stats = {"d2h_bytes": 0, "items": 0}
+                self.last_export_stats = {
+                    "d2h_bytes": 0, "items": 0, "partial": dirty_only}
                 return empty
             w = SNAP_CHUNK if n > SNAP_CHUNK else pad_pow2(n)
             wide_fn = _jitted_snap_wide(self.layout)
@@ -2568,7 +2599,8 @@ class TickEngine:
             chunks.append(cols)
             live = np.concatenate(parts)
             if len(live) == 0:
-                self.last_export_stats = {"d2h_bytes": d2h, "items": 0}
+                self.last_export_stats = {
+                    "d2h_bytes": d2h, "items": 0, "partial": dirty_only}
                 return empty
             blob, offsets = self.slots.keys_blob(live)
             snap: dict = {"key_blob": blob, "key_offsets": offsets}
@@ -2578,6 +2610,7 @@ class TickEngine:
                 "d2h_bytes": d2h,
                 "items": len(live),
                 "bytes_per_item": round(d2h / max(len(live), 1), 1),
+                "partial": dirty_only,
             }
             return snap
 
@@ -2624,6 +2657,7 @@ class TickEngine:
             _, ridx = np.unique(s[::-1], return_index=True)
             sel = sel[len(s) - 1 - ridx]
             self._last_access[slots[sel]] = self._tick_count
+            self._dirty[slots[sel]] = True
             # Chunked like evict_chunked: one restore per RESTORE_CHUNK
             # keeps the compiled width bounded — the row layout stages
             # the batch in VMEM (512 B/row), so a multi-million-item

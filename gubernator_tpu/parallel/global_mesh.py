@@ -262,6 +262,41 @@ def _make_compact(capacity: int):
     return compact
 
 
+def _make_gather_rows(n_nodes: int, my):
+    """all_gather-by-one-hot-psum over 'node' (the one collective this
+    toolchain is guaranteed to lower; see make_global_reconcile_fn)."""
+    def gather_rows(x):
+        buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
+        return lax.psum(buf, "node")
+
+    return gather_rows
+
+
+def _sparse_sets(acc_me, compact, K: int):
+    """The sparse step's working sets, derived ONCE here for both the
+    overflow probe and the sparse program — any drift between the two
+    would let an overflowing step run the truncating sparse path, so
+    they must share this function: (wmask, tmask, wslots, tslots)."""
+    wmask = acc_me[ACC_COUNT] > 0      # my queued-hit window
+    tmask = acc_me[ACC_TOUCH] > 0      # every slot I wrote locally
+    return wmask, tmask, compact(wmask, K), compact(tmask, K)
+
+
+def _mark_touched(capacity: int, n_nodes: int, slot_sets):
+    """Union of every node's compacted slot sets as a capacity mask
+    (``slot_sets``: (n, m, K) — padding rows carry ``capacity`` and
+    drop)."""
+    touched = jnp.zeros(capacity, jnp.bool_)
+    m = slot_sets.shape[1]
+
+    def mark(d, t):
+        for j in range(m):
+            t = t.at[slot_sets[d, j]].set(True, mode="drop")
+        return t
+
+    return lax.fori_loop(0, n_nodes, mark, touched)
+
+
 def make_global_overflow_fn(mesh: Mesh, capacity: int, n_nodes: int,
                             sparse_k: int):
     """Envelope probe for the sparse reconcile: (accum) → replicated
@@ -276,25 +311,13 @@ def make_global_overflow_fn(mesh: Mesh, capacity: int, n_nodes: int,
         my = lax.axis_index("node")
         acc_me = accum_blk[0]
         owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
-        compact = _make_compact(capacity)
-
-        def gather_rows(x):
-            buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
-            return lax.psum(buf, "node")
-
-        wmask = acc_me[ACC_COUNT] > 0
-        tmask = acc_me[ACC_TOUCH] > 0
+        gather_rows = _make_gather_rows(n_nodes, my)
+        wmask, tmask, wslots, tslots = _sparse_sets(
+            acc_me, _make_compact(capacity), K)
         counts = gather_rows(jnp.stack([
             jnp.count_nonzero(wmask), jnp.count_nonzero(tmask)]))
-        all_w = gather_rows(jnp.stack([
-            compact(wmask, K), compact(tmask, K)]))   # (n, 2, K)
-        touched = jnp.zeros(capacity, jnp.bool_)
-
-        def mark(d, m):
-            m = m.at[all_w[d, 0]].set(True, mode="drop")
-            return m.at[all_w[d, 1]].set(True, mode="drop")
-
-        touched = lax.fori_loop(0, n_nodes, mark, touched)
+        sets = gather_rows(jnp.stack([wslots, tslots]))   # (n, 2, K)
+        touched = _mark_touched(capacity, n_nodes, sets)
         bcounts = gather_rows(jnp.count_nonzero(touched & owned))
         return (jnp.max(counts) > K) | (jnp.max(bcounts) > K2)
 
@@ -360,11 +383,7 @@ def make_global_reconcile_fn(
         acc_me = accum_blk[0]
 
         owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
-
-        def gather_rows(x):
-            """all_gather x over 'node' via one-hot psum → (n_nodes, *x.shape)."""
-            buf = jnp.zeros((n_nodes,) + x.shape, x.dtype).at[my].set(x)
-            return lax.psum(buf, "node")
+        gather_rows = _make_gather_rows(n_nodes, my)
 
         def dense_recon(_):
             # broadcastPeers as a collective: every node contributes its
@@ -445,11 +464,14 @@ def make_global_reconcile_fn(
                     lambda n, b: jnp.where(valid, n, b), new_state, st
                 )
 
+            # ACC_TOUCH is sparse-only bookkeeping; the dense exchange
+            # moves the three rows it reads.
+            acc3 = acc_me[:ACC_TOUCH]
             if strict_sequencing:
                 # sendHits, exactly: every node's window is one batch at
                 # the authority, applied in node order (all_gather +
                 # on-device fold).
-                acc_all = gather_rows(acc_me)  # (n, ACC_ROWS, capacity)
+                acc_all = gather_rows(acc3)  # (n, 3, capacity)
 
                 def fold(d, st):
                     return apply(
@@ -462,7 +484,7 @@ def make_global_reconcile_fn(
                 merged = lax.fori_loop(0, n_nodes, fold, base)
             else:
                 # sendHits as one reduction: cluster-total hits per slot.
-                acc = lax.psum(acc_me, "node")
+                acc = lax.psum(acc3, "node")
                 merged = apply(
                     base, acc[ACC_HITS], acc[ACC_RESET], acc[ACC_COUNT] > 0
                 )
@@ -482,12 +504,8 @@ def make_global_reconcile_fn(
         # ------------------------------------------------------------------
         K = int(sparse_k)
         K2 = 2 * K
-        compact = _make_compact(capacity)
-
-        wmask = acc_me[ACC_COUNT] > 0          # my queued-hit window
-        tmask = acc_me[ACC_TOUCH] > 0          # every slot I wrote locally
-        wslots = compact(wmask, K)
-        tslots = compact(tmask, K)
+        _, _, wslots, tslots = _sparse_sets(
+            acc_me, _make_compact(capacity), K)
 
         wsl = jnp.clip(wslots, 0, capacity - 1)
         payload = jnp.concatenate([
@@ -500,7 +518,7 @@ def make_global_reconcile_fn(
 
         def sparse_recon(_):
             W = gather_rows(payload)            # (n, 13, K)
-            T = gather_rows(tslots)             # (n, K)
+            sets = gather_rows(jnp.stack([wslots, tslots]))  # (n, 2, K)
 
             # sendHits at the authority: fold each node's window into MY
             # owned rows, node order (strict semantics; the non-strict
@@ -546,16 +564,13 @@ def make_global_reconcile_fn(
 
             # broadcastPeers, sparse: my owned rows that changed (any
             # node's window) or that any node provisionally wrote (its
-            # touch set) ship to every replica; receivers scatter them in.
-            touched = jnp.zeros(capacity, jnp.bool_)
-
-            def mark(d, m):
-                m = m.at[W[d, 0].astype(I32)].set(True, mode="drop")
-                return m.at[T[d]].set(True, mode="drop")
-
-            touched = lax.fori_loop(0, n_nodes, mark, touched)
+            # touch set) ship to every replica; receivers scatter them
+            # in.  The union derivation is shared with the overflow
+            # probe (_mark_touched) so the K2 bound it checked is
+            # exactly the set compacted here.
+            touched = _mark_touched(capacity, n_nodes, sets)
             bmask = touched & owned
-            bslots = compact(bmask, K2)
+            bslots = _make_compact(capacity)(bmask, K2)
             bsl = jnp.clip(bslots, 0, capacity - 1)
             rows = gather_state(st, bsl)
             BS = gather_rows(bslots)
@@ -668,9 +683,15 @@ class MeshGlobalEngine:
             make_global_process_fn(self.mesh, self.capacity, self.n_nodes),
             donate_argnums=(0, 1, 2),
         )
+        # The sparse program always sequences per-node windows (its
+        # per-window params force it), so when it is enabled the dense
+        # overflow fallback must sequence too — otherwise the same
+        # traffic would flip semantics on whichever steps happen to
+        # overflow the envelope.
         self._recon_dense = jax.jit(
             make_global_reconcile_fn(
-                self.mesh, self.capacity, self.n_nodes, strict_sequencing
+                self.mesh, self.capacity, self.n_nodes,
+                strict_sequencing or bool(self.sparse_k),
             ),
             donate_argnums=(0, 2),
         )

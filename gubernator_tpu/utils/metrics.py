@@ -29,6 +29,25 @@ class Metrics:
         self.registry = CollectorRegistry()
         reg = self.registry
 
+        # Build stamp (Prometheus build_info convention; the reference
+        # stamps Version via ldflags and logs it at startup,
+        # cmd/gubernator/main.go:39,53).
+        import platform as _platform
+
+        from gubernator_tpu.version import VERSION
+
+        self.build_info = Gauge(
+            "gubernator_build_info",
+            "Build/version stamp; value is always 1.",
+            ["version", "python", "machine"],
+            registry=reg,
+        )
+        self.build_info.labels(
+            version=VERSION,
+            python=_platform.python_version(),
+            machine=_platform.machine(),
+        ).set(1)
+
         # gubernator.go:60-111 service families.
         self.getratelimit_counter = Counter(
             "gubernator_getratelimit_counter",

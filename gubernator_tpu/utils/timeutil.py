@@ -52,7 +52,12 @@ def _interval_bounds(now_ms_: int, d: int) -> tuple[int, int]:
         start = dt.replace(hour=0, minute=0, second=0, microsecond=0)
         nxt = start + timedelta(days=1)
     elif d == GREGORIAN_WEEKS:
-        raise GregorianError("`Duration = GregorianWeeks` not yet supported")
+        # The reference left weeks as a TODO ("consider making a PR!",
+        # interval.go:132); implemented here as ISO-8601 weeks — the
+        # interval runs Monday 00:00:00.000 through Sunday 23:59:59.999.
+        start = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+        start -= timedelta(days=dt.weekday())
+        nxt = start + timedelta(days=7)
     elif d == GREGORIAN_MONTHS:
         start = dt.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
         if start.month == 12:
@@ -78,7 +83,8 @@ def gregorian_duration(now_ms_: int, d: int) -> int:
         return 3_600_000
     if d == GREGORIAN_DAYS:
         return 86_400_000
-    start, nxt = _interval_bounds(now_ms_, d)  # raises for weeks / invalid
+    start, nxt = _interval_bounds(now_ms_, d)  # raises for invalid d;
+    # weeks/months/years computed from the interval bounds
     return nxt - start
 
 

@@ -1,0 +1,20 @@
+"""Single source for the release version.
+
+The reference stamps ``Version`` into its binary via ldflags and keeps a
+``version`` file + packaging metadata in sync, checked by
+``contrib/check-version.sh`` — the analogs here are this module, the
+repo-root ``version`` file, ``pyproject.toml``, and our
+``contrib/check-version.sh``.
+"""
+
+VERSION = "0.2.0"
+
+
+def banner() -> str:
+    """The startup identification line (cmd/gubernator/main.go:53)."""
+    import platform
+
+    return (
+        f"gubernator-tpu {VERSION} "
+        f"(python {platform.python_version()}/{platform.machine()})"
+    )

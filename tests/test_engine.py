@@ -130,6 +130,44 @@ def test_snapshot_roundtrip():
     assert rs[1].remaining == 3
 
 
+def test_incremental_export_ships_only_touched():
+    """dirty_only export after a baseline: only the keys mutated since
+    the last export cross, and a delta loads as upserts over the full
+    snapshot (store.go:49-65 OnChange trickle analog)."""
+    s = Sim()
+    s.batch([req(key=f"k{i}", hits=1) for i in range(16)])
+    full = s.engine.export_columns()          # baseline; clears dirty
+    assert len(full["key_offsets"]) - 1 == 16
+
+    delta0 = s.engine.export_columns(dirty_only=True)
+    assert len(delta0["key_offsets"]) - 1 == 0   # nothing touched since
+
+    s.batch([req(key="k3", hits=2), req(key="k7", hits=5)])
+    delta = s.engine.export_columns(dirty_only=True)
+    keys = {
+        delta["key_blob"][
+            delta["key_offsets"][i]:delta["key_offsets"][i + 1]
+        ].decode()
+        for i in range(len(delta["key_offsets"]) - 1)
+    }
+    assert keys == {"t_k3", "t_k7"}
+    assert s.engine.last_export_stats["partial"] is True
+
+    # Baseline + delta reconstructs the touched keys' exact state.
+    s2 = Sim()
+    s2.engine.load_columns(full, now=s2.now)
+    s2.engine.load_columns(delta, now=s2.now)
+    rs = s2.batch([req(key="k3", hits=0), req(key="k7", hits=0),
+                   req(key="k0", hits=0)])
+    assert rs[0].remaining == 7   # 10 - 1 - 2
+    assert rs[1].remaining == 4   # 10 - 1 - 5
+    assert rs[2].remaining == 9   # baseline only
+
+    # A second delta is empty again (export reset the dirty set).
+    assert len(
+        s.engine.export_columns(dirty_only=True)["key_offsets"]) - 1 == 0
+
+
 def test_empty_batch():
     s = Sim()
     assert s.batch([]) == []
