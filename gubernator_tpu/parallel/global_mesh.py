@@ -149,12 +149,18 @@ def make_global_mesh(n_nodes: Optional[int] = None,
     return Mesh(np.array(list(devices)), ("node",))
 
 
-def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
+def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int,
+                           track_touch: bool = False):
     """Per-node GLOBAL request application + hit accumulation.
 
     ``state``/``aux``/``accum`` carry one replica row per node (sharded over
     'node'); ``reqs`` is ``(n_nodes, len(REQ_ROWS), B)`` — block *d* holds
     the requests that arrived at node *d* this window.
+
+    ``track_touch`` maintains the ACC_TOUCH row the sparse reconcile
+    needs; dense-only engines skip it (the int64 scatter-add is the
+    most expensive op in this program, and the dense step never reads
+    the row).
     """
     slice_sz = capacity // n_nodes
 
@@ -217,12 +223,15 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int):
         queue = r.valid & ~owned & (r.hits != 0)
         qslot = jnp.where(queue, r.slot, capacity)
         reset = queue & ((r.behavior & Behavior.RESET_REMAINING) != 0)
-        tslot = jnp.where(r.valid, r.slot, capacity)
+        touch = acc[ACC_TOUCH]
+        if track_touch:
+            tslot = jnp.where(r.valid, r.slot, capacity)
+            touch = touch.at[tslot].add(r.valid.astype(I64), mode="drop")
         acc = jnp.stack([
             acc[ACC_HITS].at[qslot].add(jnp.where(queue, r.hits, 0), mode="drop"),
             acc[ACC_RESET].at[qslot].add(reset.astype(I64), mode="drop"),
             acc[ACC_COUNT].at[qslot].add(queue.astype(I64), mode="drop"),
-            acc[ACC_TOUCH].at[tslot].add(r.valid.astype(I64), mode="drop"),
+            touch,
         ])
 
         packed = jnp.stack([
@@ -680,7 +689,10 @@ class MeshGlobalEngine:
             jnp.zeros((self.n_nodes, ACC_ROWS, self.capacity), I64), mat
         )
         self._proc = jax.jit(
-            make_global_process_fn(self.mesh, self.capacity, self.n_nodes),
+            make_global_process_fn(
+                self.mesh, self.capacity, self.n_nodes,
+                track_touch=bool(self.sparse_k),
+            ),
             donate_argnums=(0, 1, 2),
         )
         # The sparse program always sequences per-node windows (its

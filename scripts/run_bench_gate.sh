@@ -29,7 +29,15 @@ fi
 out=$(mktemp)
 # Pin the CPU backend: the gate compares against a CPU baseline, and a
 # stale JAX_PLATFORMS from the environment (e.g. a TPU-plugin dev shell)
-# must not leak into the candidate run.
-JAX_PLATFORMS=cpu BENCH_FAST=1 python bench.py | tail -1 > "$out"
+# must not leak into the candidate run.  The tunneled-TPU plugin's
+# sitecustomize (.axon_site on PYTHONPATH) overrides JAX_PLATFORMS via
+# jax.config at interpreter boot, so strip it too — without this the
+# "CPU" candidate silently runs on the tunnel and gates garbage.
+# Pure-shell strip: a python helper would itself boot under the
+# sitecustomize it is trying to remove.
+CLEAN_PYTHONPATH=$(printf '%s' "${PYTHONPATH:-}" | tr ':' '\n' \
+    | grep -v '\.axon_site' | paste -sd: -) || CLEAN_PYTHONPATH=""
+PYTHONPATH="$CLEAN_PYTHONPATH" JAX_PLATFORMS=cpu BENCH_FAST=1 \
+    python bench.py | tail -1 > "$out"
 echo "candidate: $(cat "$out" | head -c 300)..."
 python scripts/check_bench_regression.py "$baseline" "$out"
