@@ -32,6 +32,23 @@ else
   echo 'pyproject.toml OK'
 fi
 
+# Helm chart (reference checks both version and appVersion).
+CHART=contrib/charts/gubernator-tpu/Chart.yaml
+HELM_VERSION=$(sed -n 's/^version: *//p' "$CHART")
+if [ "$VERSION" != "$HELM_VERSION" ]; then
+  echo "Helm chart version mismatch: $VERSION <=> $HELM_VERSION" >&2
+  RETCODE=1
+else
+  echo 'Helm chart version OK'
+fi
+HELM_APPVERSION=$(sed -n 's/^appVersion: *"\(.*\)"/\1/p' "$CHART")
+if [ "$VERSION" != "$HELM_APPVERSION" ]; then
+  echo "Helm chart appVersion mismatch: $VERSION <=> $HELM_APPVERSION" >&2
+  RETCODE=1
+else
+  echo 'Helm chart appVersion OK'
+fi
+
 # If release tags exist, they must agree too (reference behavior).
 TAG=$(git describe --tags "$(git rev-list --tags --max-count=1 2>/dev/null)" 2>/dev/null | sed -e 's/^v//')
 if [ -n "$TAG" ] && [ "$VERSION" != "$TAG" ]; then

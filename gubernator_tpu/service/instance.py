@@ -372,7 +372,18 @@ class V1Instance:
             raise BatchTooLargeError(
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
             )
-        self.metrics.concurrent_checks.inc()
+        return await self._columns_tick(cols)
+
+    async def _columns_tick(self, cols, public: bool = True):
+        """One tick-loop submission for a columnar batch + metrics.
+
+        ``public`` marks the public GetRateLimits edge, which alone
+        carries the concurrent-checks gauge and the GetRateLimits
+        duration family (reference gubernator.go:188-199); the peer
+        relay edge records only the local-handling metrics its object
+        path does (_submit_local)."""
+        if public:
+            self.metrics.concurrent_checks.inc()
         t0 = time.perf_counter()
         try:
             mat, errors = await asyncio.wrap_future(
@@ -388,12 +399,15 @@ class V1Instance:
                 self.metrics.over_limit_counter.inc(over)
             return mat, errors
         finally:
-            self.metrics.concurrent_checks.dec()
-            for name in ("V1Instance.GetRateLimits",
-                         "V1Instance.getLocalRateLimit"):
-                self.metrics.func_duration.labels(name=name).observe(
-                    time.perf_counter() - t0
-                )
+            dt = time.perf_counter() - t0
+            if public:
+                self.metrics.concurrent_checks.dec()
+                self.metrics.func_duration.labels(
+                    name="V1Instance.GetRateLimits"
+                ).observe(dt)
+            self.metrics.func_duration.labels(
+                name="V1Instance.getLocalRateLimit"
+            ).observe(dt)
 
     def _submit_local(self, reqs: List[RateLimitRequest], *, is_owner: bool):
         """Send a batch through the tick loop; wraps the future for await and
@@ -522,6 +536,33 @@ class V1Instance:
     # ------------------------------------------------------------------
     # Peer API (PeersV1)
     # ------------------------------------------------------------------
+    def peer_columns_fast_path_ok(self) -> bool:
+        """Whether GetPeerRateLimits may run wire→columns→device: unlike
+        the public gate (columns_fast_path_ok) this does NOT require
+        standalone — a relayed batch is processed locally regardless of
+        ring ownership (the reference's peer side just processes what
+        arrives, gubernator.go:497-536).  The transport still falls back
+        per batch for GLOBAL/metadata/error items (GLOBAL owner-side
+        queueing and trace extraction need request objects)."""
+        return (
+            self.conf.store is None
+            and not self.conf.behaviors.force_global
+            and self.global_mesh is None
+            and hasattr(self.engine, "submit_cols")
+        )
+
+    async def get_peer_rate_limits_columns(self, cols):
+        """Columnar owner-side handling of a relayed batch (the peer-edge
+        twin of get_rate_limits_columns; eligibility per
+        peer_columns_fast_path_ok)."""
+        if len(cols) > MAX_BATCH_SIZE:
+            self.metrics.check_error_counter.labels(error="Request too large").inc()
+            raise BatchTooLargeError(
+                f"'PeerRequest.rate_limits' list too large; max size is "
+                f"'{MAX_BATCH_SIZE}'"
+            )
+        return await self._columns_tick(cols, public=False)
+
     async def get_peer_rate_limits(
         self, requests: Sequence[RateLimitRequest]
     ) -> List[RateLimitResponse]:

@@ -108,6 +108,66 @@ class _TraceInterceptor(grpc.aio.ServerInterceptor):
         )
 
 
+async def _parse_pb(msg_type, raw: bytes, context):
+    """Protobuf-parse raw request bytes; malformed input aborts with
+    INVALID_ARGUMENT (the status a deserializer failure produced before
+    the pass-through deserializers moved parsing into the servicers —
+    without this, DecodeError would surface as UNKNOWN plus a server
+    traceback per bad request)."""
+    try:
+        return msg_type.FromString(raw)
+    except Exception as e:
+        await context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            f"failed to parse {msg_type.DESCRIPTOR.name}: {e}",
+        )
+
+
+def _item_responses(mat, errs):
+    """Fallback per-item pb responses when a columnar batch carried
+    per-item engine errors (rare; carries strings)."""
+    status, limit, remaining, reset = (mat[r].tolist() for r in range(4))
+    return [
+        pb.RateLimitResp(error=errs[i])
+        if i in errs
+        else pb.RateLimitResp(
+            status=status[i],
+            limit=limit[i],
+            remaining=remaining[i],
+            reset_time=reset[i],
+        )
+        for i in range(len(status))
+    ]
+
+
+async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type):
+    """The shared raw-bytes fast path of both rate-limit edges: native
+    wire parse → columns → device tick → native wire encode, with no
+    protobuf objects.  Returns ``(result, msg)``: ``result`` is the
+    response (bytes, or a per-item response list for the error
+    fallback) or None when the batch needs the object path; ``msg`` is
+    the protobuf message if one was already parsed along the way (so
+    the caller's object path doesn't parse twice)."""
+    msg = None
+    if gate_ok:
+        parsed = fastwire.parse_req(raw)
+        if parsed is None:  # codec unavailable or malformed bytes
+            msg = await _parse_pb(msg_type, raw, context)
+            parsed = convert.columns_from_pb(msg.requests)
+        cols, errors, special = parsed
+        if not special and not errors:
+            try:
+                mat, errs = await tick(cols)
+            except BatchTooLargeError as e:
+                await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+            if not errs:
+                # Native wire encoding straight from the matrix; the
+                # method's pass-through serializer ships bytes as-is.
+                return fastwire.encode_resp(mat), msg
+            return _item_responses(mat, errs), msg
+    return None, msg
+
+
 class V1Servicer:
     """pb ↔ dataclass edge for the public service.
 
@@ -122,57 +182,19 @@ class V1Servicer:
     def __init__(self, instance: V1Instance):
         self.instance = instance
 
-    @staticmethod
-    async def _from_string(raw: bytes, context):
-        """Protobuf-parse raw request bytes; malformed input aborts with
-        INVALID_ARGUMENT (the status a deserializer failure produced
-        before the pass-through deserializer moved parsing in here —
-        without this, DecodeError would surface as UNKNOWN plus a server
-        traceback per bad request)."""
-        try:
-            return pb.GetRateLimitsReq.FromString(raw)
-        except Exception as e:
-            await context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"failed to parse GetRateLimitsReq: {e}",
-            )
-
     async def GetRateLimits(self, raw: bytes, context):
-        msg = None
-        if self.instance.columns_fast_path_ok():
-            parsed = fastwire.parse_req(raw)
-            if parsed is None:  # codec unavailable or malformed bytes
-                msg = await self._from_string(raw, context)
-                parsed = convert.columns_from_pb(msg.requests)
-            cols, errors, special = parsed
-            if not special and not errors:
-                try:
-                    mat, errs = await self.instance.get_rate_limits_columns(
-                        cols
-                    )
-                except BatchTooLargeError as e:
-                    await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
-                if not errs:
-                    # Native wire encoding straight from the matrix; the
-                    # method's pass-through serializer ships these bytes
-                    # as-is.
-                    return fastwire.encode_resp(mat)
-                status, limit, remaining, reset = (
-                    mat[r].tolist() for r in range(4)
-                )
-                return pb.GetRateLimitsResp(responses=[
-                    pb.RateLimitResp(error=errs[i])
-                    if i in errs
-                    else pb.RateLimitResp(
-                        status=status[i],
-                        limit=limit[i],
-                        remaining=remaining[i],
-                        reset_time=reset[i],
-                    )
-                    for i in range(len(status))
-                ])
+        fast, msg = await _raw_columns_edge(
+            raw, context,
+            self.instance.columns_fast_path_ok(),
+            self.instance.get_rate_limits_columns,
+            pb.GetRateLimitsReq,
+        )
+        if fast is not None:
+            if isinstance(fast, bytes):
+                return fast
+            return pb.GetRateLimitsResp(responses=fast)
         if msg is None:
-            msg = await self._from_string(raw, context)
+            msg = await _parse_pb(pb.GetRateLimitsReq, raw, context)
         try:
             out = await self.instance.get_rate_limits(
                 convert.reqs_from_pb(msg.requests)
@@ -189,15 +211,36 @@ class V1Servicer:
 
 
 class PeersServicer:
-    """pb ↔ dataclass edge for the peer service."""
+    """pb ↔ dataclass edge for the peer service.
+
+    ``GetPeerRateLimits`` receives RAW bytes like the public edge
+    (pass-through deserializer): GetPeerRateLimitsReq shares
+    GetRateLimitsReq's wire shape (field 1, repeated RateLimitReq), so
+    the native codec parses it directly; GLOBAL/metadata/error batches
+    fall back to the object path (trace extraction and owner-side
+    GLOBAL queueing need request objects)."""
 
     def __init__(self, instance: V1Instance):
         self.instance = instance
 
-    async def GetPeerRateLimits(self, request, context):
+    async def GetPeerRateLimits(self, raw: bytes, context):
+        fast, msg = await _raw_columns_edge(
+            raw, context,
+            self.instance.peer_columns_fast_path_ok(),
+            self.instance.get_peer_rate_limits_columns,
+            peers_pb.GetPeerRateLimitsReq,
+        )
+        if fast is not None:
+            if isinstance(fast, bytes):
+                # Same wire shape as GetRateLimitsResp (field 1,
+                # repeated RateLimitResp) — bytes ship as-is.
+                return fast
+            return peers_pb.GetPeerRateLimitsResp(rate_limits=fast)
+        if msg is None:
+            msg = await _parse_pb(peers_pb.GetPeerRateLimitsReq, raw, context)
         try:
             out = await self.instance.get_peer_rate_limits(
-                convert.reqs_from_pb(request.requests)
+                convert.reqs_from_pb(msg.requests)
             )
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))

@@ -57,8 +57,12 @@ def peers_handler(servicer) -> grpc.GenericRpcHandler:
         {
             "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
                 servicer.GetPeerRateLimits,
-                request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
-                response_serializer=peers_pb.GetPeerRateLimitsResp.SerializeToString,
+                # Pass-through both ways, like V1.GetRateLimits: the
+                # servicer runs the native codec on the raw bytes.
+                request_deserializer=lambda b: b,
+                response_serializer=lambda m: (
+                    m if isinstance(m, bytes) else m.SerializeToString()
+                ),
             ),
             "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
                 servicer.UpdatePeerGlobals,
