@@ -551,20 +551,25 @@ def rung_herd(unique_dps, algo, label):
 
 def rung_herd_device():
     """Transport-free herd evidence: chained-``fori_loop`` differential
-    ticks (the kernel_1m methodology) for three 4096-batch shapes on one
-    1<<17-slot table —
+    ticks (the kernel_1m methodology) for 4096-batch shapes on one
+    1<<17-slot table, each through the program the ENGINE would run on
+    the auto layout (fused row kernels on real TPU, columns on CPU) —
 
-      unique      4096 distinct keys through the production unique
-                  program (tick32; the baseline the others divide by)
-      herd        one hot key x4096, identical requests, through the
-                  sorted chained-unit program (production routes this
-                  shape to the GROUPED program — kernel_zipf_10m is that
-                  evidence — so this rung shows the fallback holds too)
-      herd_mixed  one hot key x~3700 with RESET rows sprinkled in plus
-                  unique cold keys (round 3's 6.5 s head-of-line corner)
-                  through the same sorted program: cost is
-                  ceil(units/8) gather+scatter rounds with the
-                  sequential unit chain riding registers
+      unique          4096 distinct keys, production unique program
+                      (the baseline the others divide by)
+      herd            one hot key x4096, identical requests, through
+                      the sorted chained-unit FALLBACK program
+                      (production routes this shape to the GROUPED
+                      program — kernel_zipf_10m is that evidence)
+      herd_mixed      one hot key x~3700 with RESET rows sprinkled in
+                      plus unique cold keys (round 3's 6.5 s
+                      head-of-line corner) through the LAYERED pipeline
+                      — the production path for mixed duplicate groups:
+                      one narrow merged tick per unit layer, chained
+                      through the table
+      herd_mixed_seq  the same shape through the sequential chained-unit
+                      program — the always-correct fallback the layered
+                      plan's eligibility gate retreats to
 
     The engine-level herd rungs ride the tunnel and its 3x run-to-run
     swing made the O(1)-rounds claim unfalsifiable from the ladder
@@ -573,25 +578,18 @@ def rung_herd_device():
 
     from gubernator_tpu.ops.buckets import BucketState
     from gubernator_tpu.ops.engine import (
-        REQ32_INDEX as R32, REQ32_ROWS, pack_wide_rows)
+        REQ32_INDEX as R32, REQ32_ROWS, build_layer_plan,
+        make_layout_choice, pack_wide_rows)
+    from gubernator_tpu.ops.rowtable import RowState
     from gubernator_tpu.ops.tick32 import (
-        make_sorted_tick32_rows_fn, make_tick32_rows_fn)
+        jitted_layered_pipeline, make_sorted_tick32_rows_fn)
     from gubernator_tpu.types import Behavior
 
     capacity = 1 << 17
     batch = 4096
     now = 1_700_000_000_000
-    # Row-tuple carries (not a stacked (6, B) matrix): stacking inside
-    # the chained fori would hand XLA:CPU a concatenate-rooted
-    # mega-fusion over the deep parts graphs (see tick32's
-    # make_tick32_rows_fn docstring).
-    ticks = {
-        "unique": make_tick32_rows_fn(capacity, "columns"),
-        "herd": make_sorted_tick32_rows_fn(capacity, "columns"),
-        "herd_mixed": make_sorted_tick32_rows_fn(capacity, "columns"),
-    }
-    # The columns layout isolates the merge machinery from the row
-    # layout's DMA profile; both layouts share the same tick structure.
+    layout = make_layout_choice("auto", capacity, jax.devices()[0], batch)
+    zeros = RowState.zeros if layout == "row" else BucketState.zeros
 
     def build(slots, behavior=None):
         m = np.zeros((REQ32_ROWS, batch), np.int32)
@@ -604,12 +602,11 @@ def rung_herd_device():
                            slice(None))
         if behavior is not None:
             m[R32["behavior"]] = behavior
-        return jnp.asarray(m)
+        return m
 
     rng = np.random.default_rng(3)
-    shapes = {}
-    shapes["unique"] = build(rng.permutation(capacity)[:batch])
-    shapes["herd"] = build(np.zeros(batch, np.int64))
+    m_unique = build(rng.permutation(capacity)[:batch])
+    m_herd = build(np.zeros(batch, np.int64))
     hot = np.zeros(batch, np.int64)
     hot[: batch // 10] = rng.permutation(np.arange(1, capacity))[: batch // 10]
     behavior = np.zeros(batch, np.int32)
@@ -618,31 +615,51 @@ def rung_herd_device():
     reset_at = rng.choice(np.flatnonzero(np.sort(hot) == 0), 8,
                           replace=False)
     behavior[reset_at] = int(Behavior.RESET_REMAINING)
-    shapes["herd_mixed"] = build(hot, behavior)
+    m_mixed = build(hot, behavior)
+
+    # Unique: the production program via _tick_for_chain (fused on TPU).
+    uniq_tick, uniq_zero = _tick_for_chain(capacity, layout, batch)
+    sort_rows = make_sorted_tick32_rows_fn(capacity, layout)
+    rows_zero = tuple(jnp.zeros(batch, jnp.int32) for _ in range(6))
+
+    plan = build_layer_plan(m_mixed, batch, capacity, now)
+    assert plan is not None
+    mh0, cnt0, mhk, cntk, uidx, rank, kpad = plan
+    layered = jitted_layered_pipeline(capacity, layout, mh0.shape[1], kpad)
+    MH0, CNT0 = jnp.asarray(mh0), jnp.asarray(cnt0)
+    MHK, CNTK = jnp.asarray(mhk), jnp.asarray(cntk)
+    UIDX, RNK = jnp.asarray(uidx), jnp.asarray(rank)
+
+    def layered_tick(s, m32, t):
+        return layered(s, MH0, CNT0, MHK, CNTK, m32, UIDX, RNK, t)
+
+    cases = {
+        "unique": (uniq_tick, m_unique, uniq_zero),
+        "herd": (sort_rows, m_herd, rows_zero),
+        "herd_mixed": (layered_tick, m_mixed,
+                       jnp.zeros((6, batch), jnp.int32)),
+        "herd_mixed_seq": (sort_rows, m_mixed, rows_zero),
+    }
 
     n = 10 if FAST else 40
-    out = {"rung": "herd_device", "batch": batch}
+    out = {"rung": "herd_device", "batch": batch, "layout": layout}
     base = None
-    for label, packed in shapes.items():
-        tick = ticks[label]
+    for label, (tick, m_np, zero_resp) in cases.items():
+        packed = jnp.asarray(m_np)
 
-        def chain(iters, packed=packed, tick=tick):
+        def chain(iters, packed=packed, tick=tick, zero_resp=zero_resp):
             @jax.jit
             def run(st):
                 def body(i, carry):
                     s, _ = carry
                     return tick(s, packed, jnp.int64(now) + i)
 
-                return lax.fori_loop(
-                    0, iters, body,
-                    (st, tuple(jnp.zeros(batch, jnp.int32)
-                               for _ in range(6))))
+                return lax.fori_loop(0, iters, body, (st, zero_resp))
 
             return run
 
-        state = jax.tree.map(jnp.asarray, BucketState.zeros(capacity))
-        per, spread, _ = diff_time(
-            chain, state, n, lambda out: np.asarray(out[1][0][:1]))
+        state = jax.tree.map(jnp.asarray, zeros(capacity))
+        per, spread, _ = diff_time(chain, state, n, _resolve_chain)
         if per is None:
             out[label] = {"unreliable": True}
             continue

@@ -435,6 +435,28 @@ def group_upad(b: int, u: int = 0) -> int:
     return pad_pow2(max(u, 256, b // 4))
 
 
+def _param_rows_equal_prev(m: np.ndarray, nl: int) -> np.ndarray:
+    """(nl,) bool: row i carries identical request parameters to row
+    i-1 (the 17 REQ32 parameter rows both duplicate planners fold on —
+    ONE definition so the grouped and layered plans can never disagree
+    on unit boundaries)."""
+    R = REQ32_INDEX
+    rows = (
+        R["algorithm"], R["behavior"],
+        R["hits"], R["hits"] + 1,
+        R["limit"], R["limit"] + 1,
+        R["duration"], R["duration"] + 1,
+        R["created_at"], R["created_at"] + 1,
+        R["burst"], R["burst"] + 1,
+        R["greg_exp"], R["greg_exp"] + 1,
+        R["greg_dur"], R["greg_dur"] + 1,
+    )
+    eq = np.ones(nl, bool)
+    for r in rows:
+        eq[1:] &= m[r, 1:nl] == m[r, : nl - 1]
+    return eq
+
+
 def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
                      min_dup_frac: float = 1 / 8):
     """Host-side grouped-tick plan for a slot-sorted compact batch (the
@@ -480,19 +502,7 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
     gid = np.cumsum(is_start) - 1
     rank = np.arange(n, dtype=np.int32) - starts[gid].astype(np.int32)
 
-    PARAM_ROWS = (
-        R["algorithm"], R["behavior"],
-        R["hits"], R["hits"] + 1,
-        R["limit"], R["limit"] + 1,
-        R["duration"], R["duration"] + 1,
-        R["created_at"], R["created_at"] + 1,
-        R["burst"], R["burst"] + 1,
-        R["greg_exp"], R["greg_exp"] + 1,
-        R["greg_dur"], R["greg_dur"] + 1,
-    )
-    eq_prev = np.ones(n, bool)
-    for r in PARAM_ROWS:
-        eq_prev[1:] &= m[r, 1:n] == m[r, : n - 1]
+    eq_prev = _param_rows_equal_prev(m, n)
     hits_pos = join_i32_pair(m[R["hits"], :n], m[R["hits"] + 1, :n]) > 0
     known = m[R["known"], :n] != 0
     no_merge = int(Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN)
@@ -527,6 +537,140 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
     rank_b = np.zeros(b, np.int32)
     rank_b[:n] = rank
     return mhead, count, uidx, rank_b, u
+
+
+def build_layer_plan(m: np.ndarray, n: int, capacity: int, now: int,
+                     layer_width: int = 512, max_layers: int = 32,
+                     min_dup_frac: float = 1 / 8):
+    """Host-side UNIT-LAYER plan for mixed/ineligible duplicate batches —
+    the general case :func:`build_group_plan` declines (groups broken by
+    RESET/parameter-change/query rows).
+
+    A *unit* is a maximal run of identical fold-eligible duplicates
+    (the same definition the sequential program uses,
+    :func:`_sorted_merge_plan`); layer ``k`` collects the k-th unit of
+    every slot segment.  Each layer then ticks through the NARROW merged
+    program (one head row + count per unit, closed-form fold), chained
+    through the table — layer k+1's gather sees layer k's scatter — and
+    a single elementwise expansion maps every member's response from its
+    unit's journal row.  Cost: K narrow ticks instead of one full
+    gather/scatter round per unit, where K = max units per segment.
+
+    Returns ``(mh0 (19, W0), cnt0 (W0,), mhk (K-1, 19, LW), cntk
+    (K-1, LW), uidx (B,), rank (B,), k_pad)`` or None when the batch is
+    ineligible: a count>1 unit whose head is not provably alive
+    (build_group_plan's alive_ok argument), more than ``max_layers``
+    units on one segment, a non-first layer wider than ``layer_width``
+    (adversarial shapes keep the sequential program, which is always
+    correct), or fewer than ``min_dup_frac`` of the live rows being
+    duplicates — a near-unique batch gains nothing here, and sending it
+    through would compile wide (w0 ≈ B) layered shapes that warmup
+    never prepared (the sequential program those batches keep IS
+    warmed).  ``uidx`` addresses the flattened journal (layer-0 block
+    first, then the K-1 narrow blocks); padding/error lanes are left at
+    position 0 — a real unit's journal row — and their response values
+    are unspecified, masked/sliced downstream exactly like the plain
+    tick's padding lanes."""
+    R = REQ32_INDEX
+    b = m.shape[1]
+    s = m[R["slot"], :n]
+    live = s < capacity
+    nl = int(np.count_nonzero(live))
+    if nl == 0:
+        return None
+    # Error rows carry slot == capacity and sort to the tail: the live
+    # prefix is contiguous.
+    s = s[:nl]
+    is_start = np.empty(nl, bool)
+    is_start[0] = True
+    np.not_equal(s[1:], s[:-1], out=is_start[1:])
+    dup_rows = int(np.count_nonzero(~is_start))
+    if dup_rows < max(1, int(min_dup_frac * nl)):
+        return None
+
+    eq_prev = _param_rows_equal_prev(m, nl)
+    NO_MERGE = int(Behavior.RESET_REMAINING | Behavior.DURATION_IS_GREGORIAN)
+    hits_pos = join_i32_pair(m[R["hits"], :nl], m[R["hits"] + 1, :nl]) > 0
+    ok = (
+        (is_start | eq_prev)
+        & hits_pos
+        & ((m[R["behavior"], :nl] & NO_MERGE) == 0)
+        & ((m[R["known"], :nl] != 0) | is_start)
+    )
+    unit_start = is_start | ~ok
+    heads = np.flatnonzero(unit_start)
+    u = len(heads)
+    sizes = np.diff(np.append(heads, nl)).astype(np.int32)
+
+    # Unit ordinal within its segment.
+    seg_of_unit = (np.cumsum(is_start) - 1)[heads]
+    first_unit_of_seg = np.full(seg_of_unit[-1] + 1, u, np.int64)
+    unit_idx = np.arange(u)
+    np.minimum.at(first_unit_of_seg, seg_of_unit, unit_idx)
+    ord_ = (unit_idx - first_unit_of_seg[seg_of_unit]).astype(np.int64)
+    k_layers = int(ord_.max()) + 1
+    if k_layers > max_layers:
+        return None
+    if k_layers > 1:
+        wide = np.bincount(ord_[ord_ >= 1])
+        if len(wide) and wide.max() > layer_width:
+            return None
+    # Fold-eligible heads (count>1) must come out alive: duration > 0
+    # plus created_at >= now guarantees it on every reachable branch
+    # (see build_group_plan's alive_ok derivation).
+    multi = sizes > 1
+    if multi.any():
+        hr = heads[multi]
+        dur = join_i32_pair(m[R["duration"], :nl][hr],
+                            m[R["duration"] + 1, :nl][hr])
+        created = join_i32_pair(m[R["created_at"], :nl][hr],
+                                m[R["created_at"] + 1, :nl][hr])
+        if not ((dur > 0) & (created >= now)).all():
+            return None
+
+    w0_n = int(np.count_nonzero(ord_ == 0))
+    w0 = group_upad(b, w0_n)
+    # Quantize the layer count so serving traffic compiles a handful of
+    # shapes, padding with all-padding layers (slot=capacity heads).
+    # Multiples of 4 past 4 (not pow2): each padding layer costs a real
+    # narrow tick, and pow2 rounding at k=17 would run 15 dead layers.
+    if k_layers <= 2:
+        k_pad = 2
+    elif k_layers <= 4:
+        k_pad = 4
+    else:
+        k_pad = -(-k_layers // 4) * 4
+    k_pad = min(k_pad, max_layers)
+
+    def head_block(unit_sel, width):
+        mh = np.zeros((REQ32_ROWS, width), np.int32)
+        mh[R["slot"]] = capacity
+        cnt = np.ones(width, np.int32)
+        k = len(unit_sel)
+        mh[:, :k] = m[:, :nl][:, heads[unit_sel]]
+        cnt[:k] = sizes[unit_sel]
+        return mh, cnt
+
+    # Per-unit flat journal position, layer-0 block first.
+    pos_of_unit = np.empty(u, np.int64)
+    lay0 = np.flatnonzero(ord_ == 0)
+    pos_of_unit[lay0] = np.arange(len(lay0))
+    mh0, cnt0 = head_block(lay0, w0)
+    mhk = np.zeros((k_pad - 1, REQ32_ROWS, layer_width), np.int32)
+    mhk[:, R["slot"], :] = capacity
+    cntk = np.ones((k_pad - 1, layer_width), np.int32)
+    for k in range(1, k_layers):
+        sel = np.flatnonzero(ord_ == k)
+        pos_of_unit[sel] = w0 + (k - 1) * layer_width + np.arange(len(sel))
+        mhk[k - 1], cntk[k - 1] = head_block(sel, layer_width)
+
+    gid_unit = np.cumsum(unit_start) - 1        # row → unit
+    uidx = np.zeros(b, np.int64)
+    uidx[:nl] = pos_of_unit[gid_unit]
+    rank = np.zeros(b, np.int32)
+    rank[:nl] = np.arange(nl, dtype=np.int32) - heads[gid_unit].astype(np.int32)
+    return (mh0, cnt0, mhk, cntk, uidx.astype(np.int32), rank,
+            k_pad)
 
 
 def masked_over_limit(resp_mat: np.ndarray, errors) -> int:
@@ -1928,6 +2072,7 @@ class TickEngine:
         self.metric_misses = 0
         self.metric_over_limit = 0
         self.metric_unexpired_evictions = 0
+        self.metric_layered_ticks = 0
         self._warmup()
 
     def _warmup(self) -> None:
@@ -1971,6 +2116,32 @@ class TickEngine:
                     jnp.int64(0),
                 )
                 np.asarray(resp)
+        if self.capacity >= (1 << 16):
+            # Warm the layered pipeline's most common shape (w0 at the
+            # narrow width's floor, 2 layers — what a typical mixed-herd
+            # serving batch plans to) so the first live one doesn't pay
+            # the compile; deeper/wider shapes stay lazy, as do
+            # mid-sized engines (in-process test clusters default to
+            # 50k-slot tables and rarely see mixed-duplicate traffic —
+            # their first such batch compiles then).
+            from gubernator_tpu.ops.tick32 import jitted_layered_pipeline
+
+            w = self._widths[0]
+            w0 = group_upad(w)
+            mh0 = np.zeros((REQ32_ROWS, w0), np.int32)
+            mh0[REQ32_INDEX["slot"]] = self.capacity
+            mhk = np.zeros((1, REQ32_ROWS, 512), np.int32)
+            mhk[:, REQ32_INDEX["slot"], :] = self.capacity
+            m32 = np.zeros((REQ32_ROWS, w), np.int32)
+            m32[REQ32_INDEX["slot"]] = self.capacity
+            fn = jitted_layered_pipeline(self.capacity, self.layout, w0, 2)
+            self.state, resp = fn(
+                self.state, jnp.asarray(mh0), jnp.ones(w0, np.int32),
+                jnp.asarray(mhk), jnp.ones((1, 512), np.int32),
+                jnp.asarray(m32), jnp.zeros(w, np.int32),
+                jnp.zeros(w, np.int32), jnp.int64(0),
+            )
+            np.asarray(resp)
         cols = np.zeros((8, 1), np.int64)  # valid=0 row: install is a no-op
         self.state = self._install(self.state, jnp.asarray(cols), jnp.int64(0))
         # Compile the reclaim dead-scan now too: its first invocation
@@ -2334,11 +2505,46 @@ class TickEngine:
                         jnp.asarray(rank), jnp.int64(now),
                     )
                 elif has_dups:
-                    # Mixed/ineligible groups: the sequential rank-round
-                    # program (unit-merge) preserves cross-member order.
-                    self.state, resp = self._tick(
-                        self.state, jnp.asarray(packed), jnp.int64(now)
+                    # Layered dispatch is gated to serving-scale engines
+                    # (same threshold as the grouped warmup): each
+                    # (w0, k_pad) shape is a real XLA compile, and small
+                    # test-cluster engines churning capacities would pay
+                    # a compile storm for batches the sequential program
+                    # already handles in a round or two.
+                    lplan = (
+                        build_layer_plan(packed, n, self.capacity, now)
+                        if self.capacity >= (1 << 14) else None
                     )
+                    if lplan is not None:
+                        # Mixed groups with a host layer plan: one
+                        # narrow merged tick per unit layer, chained
+                        # through the table (tick32.
+                        # jitted_layered_pipeline) — K narrow ticks
+                        # instead of one full round per unit.
+                        from gubernator_tpu.ops.tick32 import (
+                            jitted_layered_pipeline,
+                        )
+
+                        mh0, cnt0, mhk, cntk, uidx, rank, kpad = lplan
+                        self.metric_layered_ticks += 1
+                        fn = jitted_layered_pipeline(
+                            self.capacity, self.layout, mh0.shape[1], kpad
+                        )
+                        self.state, resp = fn(
+                            self.state, jnp.asarray(mh0),
+                            jnp.asarray(cnt0), jnp.asarray(mhk),
+                            jnp.asarray(cntk), jnp.asarray(packed),
+                            jnp.asarray(uidx), jnp.asarray(rank),
+                            jnp.int64(now),
+                        )
+                    else:
+                        # Adversarial shapes (over-deep/over-wide unit
+                        # structure, unprovable head liveness): the
+                        # sequential chained-unit program is always
+                        # correct.
+                        self.state, resp = self._tick(
+                            self.state, jnp.asarray(packed), jnp.int64(now)
+                        )
                 else:
                     self.state, resp = self._tick32(
                         self.state, jnp.asarray(packed), jnp.int64(now)

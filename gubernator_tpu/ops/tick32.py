@@ -243,6 +243,113 @@ def make_merged_tick32_rows_fn(capacity: int, layout: str = "columns"):
 
 
 # ----------------------------------------------------------------------
+# Layered tick: host-planned unit layers through the narrow merged core
+# ----------------------------------------------------------------------
+def _expand_sorted(flat15, m32, uidx, rank):
+    """Member responses from a flattened unit-layer journal: head values
+    gathered per member from ``flat15[:, uidx]``; request params come
+    from each member's OWN compact columns (within a unit all members
+    are identical to the head by construction, so no head-param gather
+    is needed).  Returns the six compact rows, unstacked."""
+    from gubernator_tpu.ops.engine import REQ32_INDEX
+    from gubernator_tpu.ops.transition32 import _expand_members
+
+    g = [row[uidx] for row in flat15]
+
+    def rpair(name):
+        k = REQ32_INDEX[name]
+        return p64.I64(m32[k], m32[k + 1])
+
+    return _expand_members(
+        g[:6],
+        base=p64.I64(g[6], g[7]), q=p64.I64(g[8], g[9]),
+        rate_i=p64.I64(g[10], g[11]), s0=g[12],
+        expire=p64.I64(g[13], g[14]),
+        h=rpair("hits"), limit=rpair("limit"),
+        created=rpair("created_at"),
+        algorithm=m32[REQ32_INDEX["algorithm"]],
+        behavior=m32[REQ32_INDEX["behavior"]],
+        rank=rank,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def jitted_layered_pipeline(capacity: int, layout: str, w0: int,
+                            k_layers: int, layer_width: int = 512,
+                            fused: bool | None = None):
+    """Engine entry for mixed-duplicate batches with a host layer plan
+    (engine.build_layer_plan): (state, mh0, cnt0, mhk, cntk, m32, uidx,
+    rank, now) → (state, (6, B) compact responses).
+
+    Layer 0 (every segment's first unit, up to ``w0`` heads) and then
+    ``k_layers - 1`` narrow layers each run the merged tick — gather,
+    transition, closed-form count-fold, scatter — CHAINED THROUGH THE
+    TABLE (layer k+1's gather reads layer k's scatter), so a segment's
+    units apply in exact batch order at one narrow tick per layer
+    instead of one full-width gather/scatter round per unit.  One
+    elementwise expansion then derives every member's response from its
+    unit's journal row.  The fused Pallas kernel serves the layers on
+    the row layout (real TPU); the XLA merged core serves columns/CPU.
+    """
+    if layout == "row" and _resolve_fused(fused):
+        from gubernator_tpu.ops.fusedtick import make_fused_merged_tick_fn
+        from gubernator_tpu.ops.transition32 import expand32_rowmajor
+
+        tick0 = make_fused_merged_tick_fn(capacity, chunk=min(2048, w0))
+        tickk = make_fused_merged_tick_fn(
+            capacity, chunk=min(2048, layer_width))
+
+        def run_inner(state, mh0, cnt0, mhk, cntk, m32, uidx, rank, now):
+            state, r24_0 = tick0(state, mh0, cnt0, now)   # (W0, 24)
+
+            def layer(k, carry):
+                st, J = carry
+                st, r24 = tickk(st, mhk[k], cntk[k], now)
+                return st, jax.lax.dynamic_update_slice(
+                    J, r24[None], (k, 0, 0))
+
+            J0 = jnp.zeros((max(k_layers - 1, 1), layer_width, 24), I32)
+            state, J = jax.lax.fori_loop(
+                0, k_layers - 1, layer, (state, J0))
+            flat24 = jnp.concatenate(
+                [r24_0, J.reshape(-1, 24)], axis=0)
+            return state, jnp.stack(
+                expand32_rowmajor(flat24, uidx, rank))
+
+        return jax.jit(run_inner, donate_argnums=(0,))
+
+    core = make_merged_tick32_rows_fn(capacity, layout)
+
+    def run_inner(state, mh0, cnt0, mhk, cntk, m32, uidx, rank, now):
+        state, rows0 = core(state, mh0, cnt0, now)
+
+        def layer(k, carry):
+            state, J = carry
+            state, rows = core(state, mhk[k], cntk[k], now)
+            # Journal as FIFTEEN separate carries: stacking the deep
+            # parts graphs inside the loop would hand XLA:CPU a
+            # concatenate-rooted mega-fusion (make_tick32_rows_fn).
+            J = tuple(
+                jax.lax.dynamic_update_slice(a, r[None], (k, 0))
+                for a, r in zip(J, rows)
+            )
+            return state, J
+
+        J0 = tuple(
+            jnp.zeros((max(k_layers - 1, 1), layer_width), I32)
+            for _ in range(15)
+        )
+        state, J = jax.lax.fori_loop(0, k_layers - 1, layer, (state, J0))
+        flat15 = [
+            jnp.concatenate([r0, a.reshape(-1)])
+            for r0, a in zip(rows0, J)
+        ]
+        return state, jnp.stack(_expand_sorted(flat15, m32, uidx, rank))
+
+    return jax.jit(run_inner, donate_argnums=(0,))
+
+
+# ----------------------------------------------------------------------
 # Sorted mixed-duplicate tick: chained unit rounds, parts-native
 # ----------------------------------------------------------------------
 def make_sorted_tick32_rows_fn(capacity: int, layout: str = "columns",
