@@ -2116,14 +2116,17 @@ class TickEngine:
                     jnp.int64(0),
                 )
                 np.asarray(resp)
-        if self.capacity >= (1 << 16):
+        if self.capacity >= (1 << 16) and jax.default_backend() == "tpu":
             # Warm the layered pipeline's most common shape (w0 at the
             # narrow width's floor, 2 layers — what a typical mixed-herd
             # serving batch plans to) so the first live one doesn't pay
             # the compile; deeper/wider shapes stay lazy, as do
             # mid-sized engines (in-process test clusters default to
             # 50k-slot tables and rarely see mixed-duplicate traffic —
-            # their first such batch compiles then).
+            # their first such batch compiles then).  TPU-only: the
+            # live-deadline concern is a serving chip's; on the CPU
+            # backend (tests, the fast CI gate) the same compile costs
+            # minutes per engine and lazy is the right trade.
             from gubernator_tpu.ops.tick32 import jitted_layered_pipeline
 
             w = self._widths[0]
