@@ -1327,18 +1327,26 @@ def child_global_sparse():
         "sparse_vs_dense_2e18": round(dn_small / sp_small, 2),
         "backend": "cpu-8dev",
     }
-    if not FAST:
+    if os.environ.get("GUBER_BENCH_SPARSE_DENSE22"):
         # One dense step at 2^22 — the number the sparse step deletes
         # (O(capacity x nodes): the full 4M-slot table moves and
         # transitions on every node, every 100 ms cadence tick).
+        # Opt-in: building + warming a dense 2^22 engine costs ~7 min
+        # of an 8-virtual-device CPU backend, and the figure is stable
+        # (BENCH_local_r05.json records 146 s/step, 34x the sparse
+        # step) — the default ladder must fit the driver's budget.
         dn_big, _ = measure(cap_big, 0, 1)
         out["dense_ms_cap_2e22"] = round(dn_big, 2)
         out["sparse_vs_dense_2e22"] = round(dn_big / sp_big, 2)
     print(json.dumps(out))
 
 
+_ACTIVE_CHILD = None  # the running bench subprocess, for SIGTERM cleanup
+
+
 def _run_child(flag: str, rung: str, timeout: int = 600):
     """Run one bench child on the 8-virtual-device CPU backend."""
+    global _ACTIVE_CHILD
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
@@ -1349,14 +1357,25 @@ def _run_child(flag: str, rung: str, timeout: int = 600):
         p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
     )
     try:
-        out = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), flag],
             env=env,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+        _ACTIVE_CHILD = proc
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise
+        finally:
+            _ACTIVE_CHILD = None
+        out = subprocess.CompletedProcess(
+            proc.args, proc.returncode, stdout, stderr)
         lines = out.stdout.strip().splitlines()
         if not lines:
             tail = out.stderr.strip().splitlines()[-8:]
@@ -1432,20 +1451,38 @@ def _safe(label, fn):
 
 
 def main():
+    import signal
+
     ladder = []
     rt_ms = probe_roundtrip()
     h2d_mbps, d2h_mbps = probe_bandwidth()
-    kern = _safe("kernel_1m", rung_kernel)
-    ladder.append(kern)
-    kern_z = _safe("kernel_zipf_10m", rung_kernel_zipf)
-    ladder.append(kern_z)
-    # Headline: the better of the worst-case-unique kernel and the
-    # BASELINE-config Zipf grouped kernel (both are chained device
-    # differentials; the record names which one led).
-    head = max(
-        (kern, kern_z),
-        key=lambda r: r.get("decisions_per_sec", 0) or 0,
-    )
+
+    # A driver timeout must still yield a parseable record: on SIGTERM/
+    # SIGINT, emit the compact headline from whatever rungs completed
+    # (marked truncated) instead of dying with nothing on stdout.
+    def _on_term(signum, frame):
+        try:
+            if _ACTIVE_CHILD is not None:
+                # Don't orphan a bench child (the sparse rung holds
+                # 2^22-capacity engines for up to 30 min).
+                try:
+                    _ACTIVE_CHILD.kill()
+                except OSError:
+                    pass
+            _finish(list(ladder), rt_ms, h2d_mbps, d2h_mbps,
+                    truncated=True)
+            sys.stdout.flush()
+        finally:
+            os._exit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_term)
+        except (ValueError, OSError):
+            pass  # non-main thread / restricted environment
+
+    ladder.append(_safe("kernel_1m", rung_kernel))
+    ladder.append(_safe("kernel_zipf_10m", rung_kernel_zipf))
 
     state = {}
 
@@ -1506,6 +1543,34 @@ def main():
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
 
+    _finish(ladder, rt_ms, h2d_mbps, d2h_mbps)
+
+
+def _finish(ladder, rt_ms, h2d_mbps, d2h_mbps, truncated=False):
+    """Assemble + emit the record from whatever rungs completed (the
+    normal exit path, and the SIGTERM path when a driver timeout cuts
+    the run short)."""
+    import signal
+
+    # A signal landing while THIS function writes the record must not
+    # re-enter it (double headline, half-written record file).
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+    # Headline: the better of the worst-case-unique kernel and the
+    # BASELINE-config Zipf grouped kernel (both are chained device
+    # differentials; the record names which one led).
+    kerns = [r for r in ladder
+             if r.get("rung") in ("kernel_1m", "kernel_zipf_10m")]
+    head = max(
+        kerns, key=lambda r: r.get("decisions_per_sec", 0) or 0,
+    ) if kerns else {}
+    big_p99 = next(
+        (r.get("p99_ms") for r in ladder
+         if r.get("rung") == "engine_mixed_10m_zipf"), None)
+
     # Replace the service projection's conservative 1.2 ms device-tick
     # constant with the p99_projection rung's measured w4096 figure
     # (device tick + PCIe at the serving width) when both rungs ran.
@@ -1555,6 +1620,8 @@ def main():
         "d2h_mbps": d2h_mbps,
         "ladder": ladder,
     }
+    if truncated:
+        record["truncated"] = True
     # Full ladder record goes to a FILE; the final stdout line is a
     # compact headline that fits the driver's 2000-char tail capture —
     # round 4's record came back "parsed": null because the full ladder
@@ -1564,6 +1631,10 @@ def main():
         # Fast-mode (CI gate) runs must not clobber the round record.
         "BENCH_local_fast.json" if FAST else "BENCH_local_r05.json",
     )
+    if truncated:
+        # A timeout-truncated partial ladder never overwrites a complete
+        # record (explicit BENCH_LOCAL_OUT included).
+        out_path += ".truncated"
     try:
         with open(out_path, "w") as f:
             json.dump(record, f, indent=1)
@@ -1616,6 +1687,8 @@ def compact_headline(record, ladder_file):
     head.update(extras)
     if errors:
         head["rung_errors"] = errors
+    if record.get("truncated"):
+        head["truncated"] = True
     head["ladder_file"] = ladder_file
     return head
 
