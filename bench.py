@@ -938,18 +938,33 @@ def rung_100m():
 
     ticks = 10 if FAST else 50
     done = 0
-    pending = []
+    seg_rates = []
+    tick_i = 0
     t0 = time.perf_counter()
-    for i in range(ticks):
-        c, keys = batches[i % len(batches)]
-        for ring in pickers:  # every region resolves its owner
-            ring.get_batch(keys)
-        pending.append(eng.submit_columns(c, now + 1 + i))
-        done += len(c)
-        if len(pending) >= 16:
-            resolve_ticks(pending)
-            pending.clear()
-    resolve_ticks(pending)
+    # 5 segments → median + middle-3 spread, like rung_engine (this rung
+    # previously recorded a single window, so its r3→r4 swings could not
+    # be told apart from tunnel weather).
+    for seg_ticks in [ticks // 5] * 4 + [ticks - 4 * (ticks // 5)]:
+        s0 = time.perf_counter()
+        seg_done = 0
+        pending = []
+        for _ in range(seg_ticks):
+            c, keys = batches[tick_i % len(batches)]
+            for ring in pickers:  # every region resolves its owner
+                ring.get_batch(keys)
+            pending.append(eng.submit_columns(c, now + 1 + tick_i))
+            seg_done += len(c)
+            tick_i += 1
+            # Depth 8, not 16: a 10-tick segment must still overlap
+            # dispatch with resolution mid-segment or the median
+            # measures drain-at-boundary, not the pipelined steady
+            # state the pre-segmented window measured.
+            if len(pending) >= 8:
+                resolve_ticks(pending)
+                pending.clear()
+        resolve_ticks(pending)
+        seg_rates.append(seg_done / max(time.perf_counter() - s0, 1e-9))
+        done += seg_done
     dt = time.perf_counter() - t0
 
     lat = []
@@ -959,12 +974,17 @@ def rung_100m():
         eng.process_columns(c, now=now + 1000 + i)
         lat.append((time.perf_counter() - t1) * 1e3)
     p50, p99 = _pcts(lat)
+    seg = sorted(seg_rates)
+    core = seg[1:-1] if len(seg) >= 5 else seg
     out = {
         "rung": "engine_100m_drain_reset_region",
         "keys": cap,
         "dev_fill_s": round(dev_fill_s, 1),
         "key_fill_s": round(key_fill_s, 1),
-        "decisions_per_sec": round(done / dt, 1),
+        "decisions_per_sec": round(seg[len(seg) // 2], 1),
+        "decisions_per_sec_overall": round(done / dt, 1),
+        "spread": round((core[-1] - core[0]) / max(core[-1], 1e-9), 3),
+        "spread_all": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
         "batch": batch,
         "p50_ms": round(p50, 3),
         "p99_ms": round(p99, 3),
@@ -1512,7 +1532,6 @@ def main():
             ticks=30 if FAST else 100, zipf=True, fresh_frac=0.01,
         ),
     ))
-    big_p99 = ladder[-1].get("p99_ms")
 
     ladder.append(_safe("p99_projection", rung_p99_projection))
     ladder.append(_safe("herd_device", rung_herd_device))
