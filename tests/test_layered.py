@@ -41,7 +41,8 @@ def _mixed_batch(rng, reset_frac=0.1, now=NOW):
     # Enough duplicate depth to clear the plan's min_dup_frac gate, but
     # shallow enough unit structure to stay under max_layers (the
     # param-share probability below bounds expected units per segment).
-    hot_n = int(rng.integers(max(16, n // 3), min(80, n - 2)))
+    lo = min(max(16, n // 3), 70)
+    hot_n = int(rng.integers(lo, min(80, n - 2)))
     slots = np.sort(np.concatenate([
         np.zeros(hot_n, np.int64),
         np.full(int(rng.integers(1, 10)), 7, np.int64),  # 2nd hot key
@@ -160,6 +161,51 @@ def test_plan_rejects_overdeep_segments():
         full[:n] = v
         pack_wide_rows(m, name, full, slice(None))
     assert build_layer_plan(m, n, CAP, NOW, max_layers=32) is None
+
+
+def test_plan_invariants_fuzz():
+    """Host-only structural invariants over many random eligible plans:
+    every live row's uidx lands inside the flat journal, rank is its
+    offset from its unit head, unit heads occupy distinct journal
+    positions, and per-unit counts sum back to the live row count."""
+    rng = np.random.default_rng(123)
+    checked = 0
+    for _ in range(40):
+        m, n = _mixed_batch(rng)
+        plan = build_layer_plan(m, n, CAP, NOW)
+        if plan is None:
+            continue
+        checked += 1
+        mh0, cnt0, mhk, cntk, uidx, rank, kpad = plan
+        w0 = mh0.shape[1]
+        flat_w = w0 + (kpad - 1) * mhk.shape[2]
+        live = m[R32["slot"], :n] < CAP
+        nl = int(live.sum())
+        assert (uidx[:nl] >= 0).all() and (uidx[:nl] < flat_w).all()
+        # Heads are the rank-0 rows; their journal positions are unique,
+        # every member shares its head's position, and rank is exactly
+        # the member's offset from its head row.
+        heads = np.flatnonzero(rank[:nl] == 0)
+        pos = uidx[:nl][heads]
+        assert len(np.unique(pos)) == len(pos)
+        head_of = heads[
+            np.searchsorted(heads, np.arange(nl), side="right") - 1]
+        assert (rank[:nl] == np.arange(nl) - head_of).all()
+        assert (uidx[:nl] == uidx[:nl][head_of]).all()
+        # Live counts across all layers sum to the live row count.
+        total = int(
+            cnt0[mh0[R32["slot"]] < CAP].sum()
+            + sum(
+                cntk[k][mhk[k][R32["slot"]] < CAP].sum()
+                for k in range(kpad - 1)
+            )
+        )
+        assert total == nl
+        # Every member's head shares its slot.
+        assert (
+            m[R32["slot"], :n][:nl] == m[R32["slot"], :n][head_of]
+        ).all()
+    assert checked >= 20  # the generator must mostly produce eligible plans
 
 
 def test_engine_dispatches_layered():
