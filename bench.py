@@ -1248,11 +1248,13 @@ def child_mesh():
 
     windows = [window(i) for i in range(8)]
     iters = 10 if FAST else 25
+    d0, r0 = eng.metric_reconcile_dispatches, eng.metric_reconciles
     t0 = time.perf_counter()
     for i in range(iters):
         eng.process_blocks(windows[i % len(windows)], now=now + i)
         eng.reconcile(now=now + i)
     dt = time.perf_counter() - t0
+    steps = eng.metric_reconciles - r0
     print(
         json.dumps(
             {
@@ -1260,6 +1262,9 @@ def child_mesh():
                 "nodes": n_nodes,
                 "decisions_per_sec": round(iters * n_nodes * batch / dt, 1),
                 "reconciles_per_sec": round(iters / dt, 2),
+                "dispatches_per_step": round(
+                    (eng.metric_reconcile_dispatches - d0) / max(steps, 1), 3
+                ),
                 "backend": "cpu-8dev",
             }
         )
@@ -1320,20 +1325,29 @@ def child_global_sparse():
             jax.block_until_ready(eng.state)
             return time.perf_counter() - t0
 
+        d0, r0 = eng.metric_reconcile_dispatches, eng.metric_reconciles
         loaded = [step(True, i) for i in range(reps)]
         empty = [step(False, reps + i) for i in range(reps)]
+        steps = eng.metric_reconciles - r0
+        dps = (eng.metric_reconcile_dispatches - d0) / max(steps, 1)
         return (float(np.median(loaded)) * 1e3,
-                float(np.median(empty)) * 1e3)
+                float(np.median(empty)) * 1e3, dps)
 
     reps = 3 if FAST else 5
     cap_small, cap_big = 1 << 18, 1 << 22
-    sp_small, sp_small_0 = measure(cap_small, 1024, reps)
-    dn_small, _ = measure(cap_small, 0, reps)
-    sp_big, sp_big_0 = measure(cap_big, 1024, reps)
+    sp_small, sp_small_0, sp_dps = measure(cap_small, 1024, reps)
+    dn_small, _, _ = measure(cap_small, 0, reps)
+    sp_big, sp_big_0, _ = measure(cap_big, 1024, reps)
     out = {
         "rung": "global_sparse_reconcile",
         "nodes": n_nodes,
         "hit_slots_per_node": per_node,
+        # Mesh programs per non-overflowing sparse step.  1.0 = the
+        # fused probe+reconcile (one compaction/gather pass); 2.0 would
+        # mean the probe re-gathers the envelope as a separate program —
+        # the regression the fusion removed (check_bench_regression.py
+        # gates this count exactly).
+        "dispatches_per_step": round(sp_dps, 3),
         "sparse_ms_cap_2e18": round(sp_small, 2),
         "sparse_ms_cap_2e22": round(sp_big, 2),
         # loaded-minus-empty at 2^18: the traffic-dependent term the
@@ -1355,7 +1369,7 @@ def child_global_sparse():
         # of an 8-virtual-device CPU backend, and the figure is stable
         # (BENCH_local_r05.json records 146 s/step, 34x the sparse
         # step) — the default ladder must fit the driver's budget.
-        dn_big, _ = measure(cap_big, 0, 1)
+        dn_big, _, _ = measure(cap_big, 0, 1)
         out["dense_ms_cap_2e22"] = round(dn_big, 2)
         out["sparse_vs_dense_2e22"] = round(dn_big / sp_big, 2)
     print(json.dumps(out))

@@ -2554,7 +2554,26 @@ class TickEngine:
                     )
             self._pending.clear()
             tick_slots = packed[REQ32_INDEX["slot"], :n]
-            self._dirty[tick_slots[tick_slots < self.capacity]] = True
+            # Dirty marking feeds export_columns(dirty_only=True); pure
+            # queries — hits == 0 on a known slot, no RESET_REMAINING —
+            # read bucket state without moving it, so marking them would
+            # inflate deltas under read-heavy traffic (advisor finding).
+            # Unknown slots always mark (the tick creates the row), as
+            # does RESET (removal/refill).  A leaky-bucket query can
+            # refill tokens on device, but the refill is derived from
+            # (updated_at, now) and recomputes identically after a
+            # baseline+delta restore, so skipping it loses nothing.
+            hr = REQ32_INDEX["hits"]
+            mutating = (
+                (packed[hr, :n] != 0)
+                | (packed[hr + 1, :n] != 0)
+                | (packed[REQ32_INDEX["known"], :n] == 0)
+                | ((packed[REQ32_INDEX["behavior"], :n]
+                    & int(Behavior.RESET_REMAINING)) != 0)
+            )
+            mut_slots = tick_slots[mutating & (tick_slots < self.capacity)]
+            if len(mut_slots):
+                self._dirty[mut_slots] = True
             slots_req = (
                 packed[REQ32_INDEX["slot"], :n][inv].astype(np.int64)
                 if self.store is not None

@@ -47,6 +47,7 @@ from gubernator_tpu.ops.engine import REQ32_INDEX, REQ32_ROWS
 from gubernator_tpu.ops.i64pair import I64
 from gubernator_tpu.ops.rowtable import ROW_W, _interpret
 from gubernator_tpu.ops.tfloat import T3
+from gubernator_tpu.utils import jaxcompat
 from gubernator_tpu.ops.transition32 import (
     PReq,
     PState,
@@ -59,7 +60,8 @@ F32 = jnp.float32
 # 24 table words ride the MXU transpose: ROW_USED (20) rounded up to a
 # multiple of 8 sublanes.  The transposed block is (TW, C).
 TW = 24
-_VMEM = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+_VMEM = jaxcompat.pallas_tpu_compiler_params(
+    vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _eye(n):
@@ -215,7 +217,7 @@ def make_fused_tick_fn(capacity: int, chunk: int | None = None):
                 pltpu.SemaphoreType.DMA((2,)),   # write sems (per buffer)
             ],
         )
-        with jax.enable_x64(False):
+        with jaxcompat.enable_x64(False):
             table, resp = pl.pallas_call(
                 kernel,
                 grid_spec=grid_spec,
@@ -423,7 +425,7 @@ def make_fused_merged_tick_fn(capacity: int, chunk: int | None = None):
                 pltpu.SemaphoreType.DMA((2,)),
             ],
         )
-        with jax.enable_x64(False):
+        with jaxcompat.enable_x64(False):
             table, resp = pl.pallas_call(
                 kernel,
                 grid_spec=grid_spec,

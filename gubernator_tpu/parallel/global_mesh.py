@@ -65,10 +65,12 @@ O(hits · n_nodes) ICI bytes — owners apply the windows to their
 authoritative rows with K-row gather/scatter, and only changed rows
 re-broadcast.  Reconcile cost then scales with traffic, not table size,
 lifting the envelope to multi-million-slot GLOBAL tables (hard cap
-2^24); a step that overflows the envelope falls back to the dense pass
-in-program, so the envelope is a performance knob, never a correctness
-one.  Each node still holds a full replica (~100 B/slot) — HBM, not
-ICI, bounds capacity.
+2^24).  The overflow probe is FUSED into the sparse program — the step
+compacts and gathers its envelope once and emits the probe bool
+alongside the update — and an overflowing step applies nothing and
+falls back to the dense pass (host dispatch), so the envelope is a
+performance knob, never a correctness one.  Each node still holds a
+full replica (~100 B/slot) — HBM, not ICI, bounds capacity.
 """
 
 from __future__ import annotations
@@ -81,6 +83,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.utils.jaxcompat import shard_map
 
 from gubernator_tpu.ops.buckets import (
     BucketState,
@@ -246,7 +250,7 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int,
         )
 
     state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
-    return jax.shard_map(
+    return shard_map(
         _local,
         mesh=mesh,
         in_specs=(state_spec, P("node", None, None), P("node", None, None),
@@ -312,7 +316,14 @@ def make_global_overflow_fn(mesh: Mesh, capacity: int, n_nodes: int,
     bool, True when this step's windows, touch sets, or any owner's
     re-broadcast share exceed the sparse envelopes — the caller then
     runs the dense program instead (host dispatch; see
-    make_global_reconcile_fn)."""
+    make_global_reconcile_fn).
+
+    The serving engine no longer dispatches this probe: the fused step
+    (:func:`make_global_sparse_step_fn`) computes the same bool inside
+    the sparse program itself, from the same compacted sets, so the
+    envelope is gathered ONCE per step instead of twice.  This program
+    stays as the reference half of the unfused two-program pair the
+    parity fuzz tests run against the fused step."""
     slice_sz = capacity // n_nodes
     K, K2 = int(sparse_k), 2 * int(sparse_k)
 
@@ -330,11 +341,173 @@ def make_global_overflow_fn(mesh: Mesh, capacity: int, n_nodes: int,
         bcounts = gather_rows(jnp.count_nonzero(touched & owned))
         return (jnp.max(counts) > K) | (jnp.max(bcounts) > K2)
 
-    return jax.shard_map(
+    return shard_map(
         _probe,
         mesh=mesh,
         in_specs=(P("node", None, None),),
         out_specs=P(),
+        check_vma=False,
+    )
+
+
+def make_global_sparse_step_fn(mesh: Mesh, capacity: int, n_nodes: int,
+                               sparse_k: int, strict_sequencing: bool = True,
+                               with_envelope: bool = False):
+    """The FUSED sparse reconcile: overflow probe + sparse step as one
+    mesh program — (state, aux, accum, now) → (state', accum', overflow).
+
+    The unfused pair (make_global_overflow_fn + the sparse branch of
+    make_global_reconcile_fn) compacts the per-node (window, touch) sets
+    and all-gathers them TWICE per step: once for the probe's envelope
+    counts, then again for the actual reconcile — paying the compaction
+    (an O(capacity) cumsum per set) and the set-gather collective twice
+    for the same bytes.  Here the step compacts once, rides the probe's
+    counts on two extra rows of the ONE envelope gather, and derives the
+    overflow bool in-program.  Per-owner re-broadcast shares need no
+    collective at all: the gathered touch union is replicated, so every
+    node counts every owner's K2 share from its own copy.
+
+    Overflow steps must not apply truncated envelopes, and an in-program
+    cond would re-impose the O(capacity) copy the sparse step removes
+    (see make_global_reconcile_fn) — instead the bool gates every
+    scatter (indices aim at the drop row) and the accumulator zeroing,
+    so an overflowing step returns ``state``/``accum`` bit-unchanged and
+    the host runs the rare dense fallback on them: one program per
+    normal step, two per overflowing step, never a wasted gather.
+
+    ``with_envelope`` additionally returns the gathered
+    ``(n_nodes, 4 + len(AUX_ROWS) + 3, K)`` envelope (windows + touch
+    sets + probe counts) — the parity tests' window into what crossed
+    the mesh; the serving engine leaves it off.
+
+    ``strict_sequencing`` is accepted for signature parity with
+    make_global_reconcile_fn but the sparse step always sequences
+    per-node windows (their per-window params require it).
+    """
+    del strict_sequencing  # sparse always sequences; see docstring
+    slice_sz = capacity // n_nodes
+    K, K2 = int(sparse_k), 2 * int(sparse_k)
+    NW = 4 + len(AUX_ROWS)           # window payload rows (see `payload`)
+    T_ROW, CW_ROW, CT_ROW = NW, NW + 1, NW + 2
+
+    def _step(state_blk, aux_blk, accum_blk, now):
+        my = lax.axis_index("node")
+        rep = jax.tree.map(lambda a: a[0], state_blk)
+        aux = aux_blk[0]
+        acc_me = accum_blk[0]
+        owned = (jnp.arange(capacity, dtype=I32) // slice_sz) == my.astype(I32)
+        gather_rows = _make_gather_rows(n_nodes, my)
+
+        wmask, tmask, wslots, tslots = _sparse_sets(
+            acc_me, _make_compact(capacity), K)
+        wsl = jnp.clip(wslots, 0, capacity - 1)
+        # One envelope per node, one gather per step: the window payload
+        # (slots + hits/reset/count + aux params), the touch set, and the
+        # probe's two set-size counts broadcast across the K lanes.
+        payload = jnp.concatenate([
+            wslots.astype(I64)[None],
+            acc_me[ACC_HITS][wsl][None],
+            acc_me[ACC_RESET][wsl][None],
+            acc_me[ACC_COUNT][wsl][None],
+            aux[:, wsl],
+            tslots.astype(I64)[None],
+            jnp.broadcast_to(
+                jnp.count_nonzero(wmask).astype(I64), (1, K)),
+            jnp.broadcast_to(
+                jnp.count_nonzero(tmask).astype(I64), (1, K)),
+        ])                                      # (NW + 3, K)
+        W = gather_rows(payload)                # (n, NW + 3, K)
+
+        sets = jnp.stack([W[:, 0], W[:, T_ROW]], axis=1)  # (n, 2, K)
+        touched = _mark_touched(capacity, n_nodes, sets)
+        # Probe, from the one gather: any node's set wider than K, or —
+        # counted locally on the replicated union, owner d's share being
+        # rows [d*slice_sz, (d+1)*slice_sz) — any owner's re-broadcast
+        # share wider than K2.
+        counts = W[:, CW_ROW:CT_ROW + 1, 0]     # (n, 2)
+        bcounts = jnp.sum(
+            touched.reshape(n_nodes, slice_sz).astype(I32), axis=1)
+        overflow = (jnp.max(counts) > K) | (jnp.max(bcounts) > K2)
+
+        # sendHits at the authority (identical to the unfused sparse
+        # step's fold, with ``overflow`` gating validity so a truncated
+        # envelope never lands).
+        def fold(d, st):
+            slots_d = W[d, 0].astype(I32)
+            sl = jnp.clip(slots_d, 0, capacity - 1)
+            ok = ((slots_d < capacity) & owned[sl] & (W[d, 3] > 0)
+                  & ~overflow)
+            auxd = W[d, 4:NW]
+            havep = auxd[AUX["stamp"]] > 0
+            gathered = gather_state(st, sl)
+            beh = jnp.where(havep, auxd[AUX["behavior"]], 0).astype(I32)
+            beh = beh & ~jnp.int32(Behavior.RESET_REMAINING)
+            beh = beh | jnp.int32(Behavior.DRAIN_OVER_LIMIT)
+            req = ReqBatch(
+                slot=sl,
+                known=jnp.ones(K, jnp.bool_),
+                hits=W[d, 1],
+                limit=jnp.where(
+                    havep, auxd[AUX["limit"]], gathered.limit),
+                duration=jnp.where(
+                    havep, auxd[AUX["duration"]], gathered.duration),
+                algorithm=jnp.where(
+                    havep, auxd[AUX["algorithm"]],
+                    gathered.algorithm.astype(I64)).astype(I32),
+                behavior=jnp.where(
+                    W[d, 2] > 0,
+                    beh | jnp.int32(Behavior.RESET_REMAINING), beh),
+                created_at=jnp.where(
+                    havep, auxd[AUX["created_at"]], now),
+                burst=jnp.where(
+                    havep, auxd[AUX["burst"]], gathered.burst),
+                greg_exp=jnp.where(havep, auxd[AUX["greg_exp"]], 0),
+                greg_dur=jnp.where(havep, auxd[AUX["greg_dur"]], 0),
+                valid=ok,
+            )
+            new_g, _ = bucket_transition(now, gathered, req)
+            return scatter_state(
+                st, jnp.where(ok, sl, capacity), new_g)
+
+        st = lax.fori_loop(0, n_nodes, fold, rep)
+
+        # broadcastPeers, sparse (see make_global_reconcile_fn): the
+        # union was already derived above for the probe — reused here,
+        # masked off entirely when the step overflowed.
+        bmask = touched & owned & ~overflow
+        bslots = _make_compact(capacity)(bmask, K2)
+        bsl = jnp.clip(bslots, 0, capacity - 1)
+        rows = gather_state(st, bsl)
+        BS = gather_rows(bslots)
+        BR = jax.tree.map(gather_rows, rows)
+
+        def install(d, st2):
+            sl2 = BS[d]
+            scat = jnp.where(sl2 < capacity, sl2, capacity)
+            return scatter_state(
+                st2, scat, jax.tree.map(lambda a: a[d], BR))
+
+        st = lax.fori_loop(0, n_nodes, install, st)
+        # Overflow keeps the accumulators: the host's dense fallback
+        # still has the window to apply.
+        acc_out = jnp.where(overflow, acc_me, jnp.zeros_like(acc_me))
+        out = (
+            jax.tree.map(lambda a: a[None], st),
+            acc_out[None],
+            overflow,
+        )
+        return out + (W,) if with_envelope else out
+
+    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    out_specs = (state_spec, P("node", None, None), P())
+    if with_envelope:
+        out_specs = out_specs + (P(),)
+    return shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(state_spec, P("node", None, None), P("node", None, None),
+                  P()),
+        out_specs=out_specs,
         check_vma=False,
     )
 
@@ -600,7 +773,7 @@ def make_global_reconcile_fn(
         )
 
     state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
-    return jax.shard_map(
+    return shard_map(
         _recon,
         mesh=mesh,
         in_specs=(state_spec, P("node", None, None), P("node", None, None), P()),
@@ -628,7 +801,7 @@ def make_global_evict_fn(mesh: Mesh):
             jax.tree.map(lambda a: a[None], st), aux[None], acc[None],
         )
 
-    return jax.shard_map(
+    return shard_map(
         _evict,
         mesh=mesh,
         in_specs=(state_spec, P("node", None, None), P("node", None, None), P()),
@@ -708,22 +881,25 @@ class MeshGlobalEngine:
             donate_argnums=(0, 2),
         )
         if self.sparse_k:
-            self._recon_sparse = jax.jit(
-                make_global_reconcile_fn(
-                    self.mesh, self.capacity, self.n_nodes,
-                    strict_sequencing, sparse_k=self.sparse_k,
+            # The fused step: ONE program computes the overflow probe and
+            # the sparse reconcile from a single envelope compaction +
+            # gather (the unfused probe/step pair gathered the same sets
+            # twice per step; see make_global_sparse_step_fn).
+            self._sparse_step = jax.jit(
+                make_global_sparse_step_fn(
+                    self.mesh, self.capacity, self.n_nodes, self.sparse_k,
                 ),
                 donate_argnums=(0, 2),
             )
-            self._overflow = jax.jit(
-                make_global_overflow_fn(
-                    self.mesh, self.capacity, self.n_nodes, self.sparse_k
-                )
-            )
         else:
-            self._recon_sparse = None
-            self._overflow = None
+            self._sparse_step = None
         self.metric_dense_fallbacks = 0
+        # Mesh programs launched by reconcile steps: 1 per fused sparse
+        # or dense step, 2 when an overflowing step runs the dense
+        # fallback after the fused probe.  dispatches/reconciles near
+        # 1.0 is the fusion's observable; the bench ladder exports it
+        # and scripts/check_bench_regression.py gates on it.
+        self.metric_reconcile_dispatches = 0
         self._evict = jax.jit(
             make_global_evict_fn(self.mesh), donate_argnums=(0, 1, 2)
         )
@@ -745,11 +921,11 @@ class MeshGlobalEngine:
             jax.device_put(m, self._req_sharding), jnp.int64(0), jnp.int64(0),
         )
         np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
-        if self._recon_sparse is not None:
-            np.asarray(self._overflow(self.accum))
-            self.state, self.accum = self._recon_sparse(
+        if self._sparse_step is not None:
+            self.state, self.accum, over = self._sparse_step(
                 self.state, self.aux, self.accum, jnp.int64(0)
             )
+            np.asarray(over)  # warm the probe-bool D2H path
             if self.capacity <= (1 << 20):
                 # Big tables leave the dense fallback to compile lazily on
                 # the first (rare) overflowing step; warming it would run
@@ -931,22 +1107,35 @@ class MeshGlobalEngine:
     def reconcile(self, now: Optional[int] = None) -> None:
         """One psum + all_gather reconciliation step (see module doc).
 
-        With a sparse envelope configured, a tiny device probe decides
-        dense-vs-sparse per step HOST-side — an in-program cond would
-        copy the whole untouched table through the cond output and
-        re-impose the O(capacity) cost the sparse step exists to remove.
+        With a sparse envelope configured, the FUSED step computes the
+        overflow probe inside the sparse program itself (one envelope
+        compaction + gather per step) and returns the bool alongside the
+        updated replicas.  An overflowing step applies nothing — its
+        scatters are gated off on device, so the returned state/accum
+        are the originals — and the host runs the rare dense fallback on
+        them (still a host dispatch, not an in-program cond: a cond
+        would copy the whole untouched table through the cond output and
+        re-impose the O(capacity) cost the sparse step exists to
+        remove).
         """
         with self._lock:
             now = now if now is not None else timeutil.now_ms()
-            recon = self._recon_dense
-            if self._recon_sparse is not None:
-                if bool(np.asarray(self._overflow(self.accum))):
+            if self._sparse_step is not None:
+                self.state, self.accum, over = self._sparse_step(
+                    self.state, self.aux, self.accum, jnp.int64(now)
+                )
+                self.metric_reconcile_dispatches += 1
+                if bool(np.asarray(over)):
                     self.metric_dense_fallbacks += 1
-                else:
-                    recon = self._recon_sparse
-            self.state, self.accum = recon(
-                self.state, self.aux, self.accum, jnp.int64(now)
-            )
+                    self.metric_reconcile_dispatches += 1
+                    self.state, self.accum = self._recon_dense(
+                        self.state, self.aux, self.accum, jnp.int64(now)
+                    )
+            else:
+                self.metric_reconcile_dispatches += 1
+                self.state, self.accum = self._recon_dense(
+                    self.state, self.aux, self.accum, jnp.int64(now)
+                )
             self._pending.clear()
             self._last_reconcile_ms = now
             self.metric_reconciles += 1

@@ -40,8 +40,9 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.utils.jaxcompat import shard_map
 
 from gubernator_tpu.ops import rowtable
 from gubernator_tpu.ops.buckets import BucketState, np_logical, slice_field

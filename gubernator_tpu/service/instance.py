@@ -473,11 +473,24 @@ class V1Instance:
         while not self._closed:
             await asyncio.sleep(self.conf.behaviors.global_sync_wait)
             try:
-                await loop.run_in_executor(
-                    None, self.global_mesh.maybe_reconcile
-                )
+                await loop.run_in_executor(None, self._mesh_reconcile_once)
             except Exception:
                 self.log.exception("global mesh reconcile failed")
+
+    def _mesh_reconcile_once(self) -> None:
+        """One cadence tick: reconcile + export the engine's step/dispatch
+        counters to this daemon's registry (the engine is shared across
+        co-resident daemons, so each driver exports only the deltas of
+        the steps its own call performed)."""
+        eng = self.global_mesh
+        before = (eng.metric_reconcile_dispatches, eng.metric_dense_fallbacks)
+        if not eng.maybe_reconcile():
+            return
+        self.metrics.mesh_reconcile_count.inc()
+        self.metrics.mesh_reconcile_dispatches.inc(
+            max(0, eng.metric_reconcile_dispatches - before[0]))
+        self.metrics.mesh_dense_fallbacks.inc(
+            max(0, eng.metric_dense_fallbacks - before[1]))
 
     async def _async_request(
         self, peer: PeerClient, req: RateLimitRequest, key: str

@@ -217,7 +217,12 @@ def encode_resp(mat: np.ndarray) -> bytes:
     if n == 0:
         return b""
     rows = [np.ascontiguousarray(mat[r], np.int64) for r in range(4)]
-    cap = 8 + 44 * n  # 4 fields x (1 tag + 10B varint) + header per item
+    # Worst case per item: 44 B payload (4 fields x (1 tag + 10 B
+    # varint)) + 2 B item header (1 B tag + 1 B length varint, since
+    # payload <= 44 < 128) = 46 B.  The old 44 B/item budget under-sized
+    # adversarial matrices (four 10-byte-varint fields) and leaned on
+    # the retry below.
+    cap = 8 + 46 * n
     out = np.empty(cap, np.uint8)
     wrote = lib.guber_encode_resp(rows[0], rows[1], rows[2], rows[3],
                                   n, out, cap)

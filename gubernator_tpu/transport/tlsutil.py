@@ -19,10 +19,20 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 import grpc
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+
+# ``cryptography`` is only needed for AutoTLS self-signing; file-based
+# certs and plaintext daemons must work without it (slim containers omit
+# it), so the import is gated, not required at module load.
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover - depends on container build
+    x509 = hashes = serialization = rsa = NameOID = None
+    HAVE_CRYPTO = False
 
 from gubernator_tpu.config import TLSSettings
 
@@ -246,6 +256,11 @@ def setup_tls(settings: Optional[TLSSettings]) -> Optional[TLSBundle]:
         b.client_key_pem = _read(settings.client_auth_key_file)
 
     if settings.auto_tls and not (b.cert_pem and b.key_pem):
+        if not HAVE_CRYPTO:
+            raise RuntimeError(
+                "AutoTLS needs the 'cryptography' package; install it or "
+                "point GUBER_TLS_CERT/GUBER_TLS_KEY at existing files"
+            )
         if settings.ca_file and settings.ca_key_file:
             ca_pem, ca_key_pem = b.ca_pem, _read(settings.ca_key_file)
             ca_cert = x509.load_pem_x509_certificate(ca_pem)
@@ -282,6 +297,10 @@ def main(argv=None) -> int:
                    help="extra SAN dns names (e.g. compose service names)")
     args = p.parse_args(argv)
 
+    if not HAVE_CRYPTO:
+        print("the cert generator needs the 'cryptography' package",
+              file=sys.stderr)
+        return 2
     ca_pem, ca_key_pem, ca_cert, ca_key = generate_self_ca()
     cert_pem, key_pem = generate_cert(
         ca_cert, ca_key, extra_dns=tuple(args.dns)

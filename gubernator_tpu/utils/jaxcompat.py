@@ -1,0 +1,54 @@
+"""JAX cross-version shims.
+
+The toolchain pin floats between container builds: newer JAX exposes
+``jax.shard_map`` (with the ``check_vma`` replication-check kwarg) while
+the 0.4.x line ships it as ``jax.experimental.shard_map.shard_map``
+(kwarg ``check_rep``).  The mesh data planes call through here so one
+source tree runs on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NEW = getattr(jax, "shard_map", None)
+if _NEW is None:
+    from jax.experimental.shard_map import shard_map as _OLD
+else:
+    _OLD = None
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Mosaic compiler params under either name: ``pltpu.CompilerParams``
+    (new) or ``pltpu.TPUCompilerParams`` (0.4.x line)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)
+
+
+def enable_x64(enabled: bool = True):
+    """Context manager toggling x64 for traces inside it: newer JAX has
+    ``jax.enable_x64(bool)``, the 0.4.x line only the
+    ``jax.experimental.enable_x64/disable_x64`` pair."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    from jax.experimental import disable_x64 as _dis
+    from jax.experimental import enable_x64 as _en
+
+    return _en() if enabled else _dis()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` under either API generation (see module doc)."""
+    if _NEW is not None:
+        return _NEW(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    return _OLD(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -178,6 +178,30 @@ class Metrics:
             "Requests applied per device tick.",
             registry=reg,
         )
+        # GLOBAL mesh reconcile telemetry: steps this daemon drove, mesh
+        # programs those steps launched, and dense-fallback steps.  One
+        # dispatch per step is the fused sparse/dense normal case; 2 means
+        # an envelope overflow ran the dense fallback (rare by design) —
+        # a sustained dispatch/step ratio near 2.0 means the envelope is
+        # under-sized for the traffic (or the probe fusion regressed).
+        self.mesh_reconcile_count = Counter(
+            "gubernator_tpu_mesh_reconcile_count",
+            "GLOBAL mesh reconcile steps driven by this daemon.",
+            registry=reg,
+        )
+        self.mesh_reconcile_dispatches = Counter(
+            "gubernator_tpu_mesh_reconcile_dispatches",
+            "Jitted mesh programs launched by this daemon's reconcile "
+            "steps (1 per fused sparse or dense step; +1 when an "
+            "envelope overflow runs the dense fallback).",
+            registry=reg,
+        )
+        self.mesh_dense_fallbacks = Counter(
+            "gubernator_tpu_mesh_dense_fallbacks",
+            "Sparse reconcile steps that overflowed the envelope and "
+            "fell back to the dense program.",
+            registry=reg,
+        )
 
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``

@@ -55,6 +55,9 @@ def _interval_bounds(now_ms_: int, d: int) -> tuple[int, int]:
         # The reference left weeks as a TODO ("consider making a PR!",
         # interval.go:132); implemented here as ISO-8601 weeks — the
         # interval runs Monday 00:00:00.000 through Sunday 23:59:59.999.
+        # DELIBERATE wire-visible divergence (documented in README
+        # "Features"): the reference answers GregorianWeeks with a
+        # calendar error, this implementation rate-limits.
         start = dt.replace(hour=0, minute=0, second=0, microsecond=0)
         start -= timedelta(days=dt.weekday())
         nxt = start + timedelta(days=7)

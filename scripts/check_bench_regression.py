@@ -21,6 +21,12 @@ import sys
 
 RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 
+# Exact per-step work counts (lower is better, no measurement noise):
+# a candidate exceeding its baseline re-introduced dispatch work — e.g.
+# un-fusing the sparse reconcile's overflow probe doubles
+# dispatches_per_step from 1.0 to 2.0.  Gated without spread slack.
+COUNT_KEYS = ("dispatches_per_step",)
+
 
 def load_bench(path):
     """Accept either bench.py's raw JSON line or the driver's BENCH_r*.json
@@ -92,6 +98,18 @@ def rates(doc):
         if name not in out and rs and rs[0]:
             out[name] = (float(rs[0]), None,
                          float(rs[1] or 0.0) if len(rs) > 1 else 0.0)
+    return out
+
+
+def counts(doc):
+    """(rung, count_key) → value for the exact work-count metrics
+    (COUNT_KEYS).  Unlike rates these carry no sampling noise, so the
+    gate compares them directly: candidate > baseline fails."""
+    out = {}
+    for rung in doc.get("ladder", []):
+        for k in COUNT_KEYS:
+            if rung.get(k) is not None:
+                out[(rung["rung"], k)] = float(rung[k])
     return out
 
 
@@ -170,6 +188,20 @@ def main():
             failed = True
         print(f"  {name}: {b:,.0f} -> {c:,.0f} "
               f"({1 / slowdown:.2f}x, allowed {1 / allowed:.2f}x, {mark})")
+    base_counts, cand_counts = counts(base_doc), counts(cand_doc)
+    for key in sorted(set(base_counts) & set(cand_counts)):
+        b, c = base_counts[key], cand_counts[key]
+        name = f"{key[0]}.{key[1]}"
+        gated += 1
+        # Exact counts: tiny slack only for the rare-overflow steps that
+        # can legitimately land inside a sample window.
+        mark = "FAIL" if c > b * 1.05 + 1e-9 else "ok"
+        if mark == "FAIL":
+            failed = True
+        print(f"  {name}: {b:g} -> {c:g} (count, lower is better, {mark})")
+    for key in sorted(set(base_counts) ^ set(cand_counts)):
+        side = "candidate" if key not in base_counts else "baseline"
+        print(f"  {key[0]}.{key[1]}: only in {side} — not gated")
     if gated == 0 and not args.allow_empty:
         # A gate that judged nothing must not report success (the CI job
         # would pass vacuously whenever shapes diverge — advisor r3).

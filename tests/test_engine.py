@@ -168,6 +168,58 @@ def test_incremental_export_ships_only_touched():
         s.engine.export_columns(dirty_only=True)["key_offsets"]) - 1 == 0
 
 
+def _delta_keys(delta):
+    off = delta["key_offsets"]
+    return {
+        delta["key_blob"][off[i]:off[i + 1]].decode()
+        for i in range(len(off) - 1)
+    }
+
+
+def test_query_only_tick_exports_empty_delta():
+    """Pure queries (hits == 0 on known slots) read bucket state without
+    mutating it — a query-only tick must not inflate the next
+    dirty_only delta (advisor finding: read-heavy traffic was marking
+    every requested slot)."""
+    s = Sim()
+    s.batch([req(key=f"q{i}", hits=1) for i in range(8)])
+    s.engine.export_columns()                  # baseline; clears dirty
+    s.batch([req(key=f"q{i}", hits=0) for i in range(8)])  # queries only
+    assert len(
+        s.engine.export_columns(dirty_only=True)["key_offsets"]) - 1 == 0
+
+
+def test_mixed_tick_delta_exports_exactly_mutated_slots():
+    """A mixed tick's delta carries exactly the mutated slots: hit rows
+    and query-created rows, not pure-query rows."""
+    s = Sim()
+    s.batch([req(key=f"m{i}", hits=1) for i in range(6)])
+    s.engine.export_columns()                  # baseline; clears dirty
+    s.batch([
+        req(key="m1", hits=2),                 # mutates
+        req(key="m2", hits=0),                 # pure query: no mark
+        req(key="m3", hits=0),                 # pure query: no mark
+        req(key="new", hits=0),                # creates the row: marks
+        req(key="m4", hits=0,                  # RESET removes: marks
+            behavior=Behavior.RESET_REMAINING),
+    ])
+    delta = s.engine.export_columns(dirty_only=True)
+    # m4's RESET removed the bucket (tokenBucket reset semantics), so
+    # the slot is dirty but no longer live — it has no row to export.
+    assert _delta_keys(delta) == {"t_m1", "t_new"}
+
+    # The delta applies as an upsert over the baseline and reproduces
+    # the mutated keys' state, and the untouched query keys keep their
+    # baseline state.
+    s2 = Sim()
+    s2.engine.load_columns(s.engine.export_columns(), now=s2.now)
+    rs = s2.batch([req(key="m1", hits=0), req(key="m2", hits=0),
+                   req(key="new", hits=0)])
+    assert rs[0].remaining == 7   # 10 - 1 - 2
+    assert rs[1].remaining == 9   # baseline only
+    assert rs[2].remaining == 10  # created by the query tick
+
+
 def test_empty_batch():
     s = Sim()
     assert s.batch([]) == []

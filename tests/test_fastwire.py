@@ -181,6 +181,22 @@ def test_encode_resp_byte_parity():
     assert encode_get_rate_limits_resp(mat) == ref
 
 
+def test_encode_resp_worst_case_cap():
+    """All four fields negative — every varint takes its full 10 bytes,
+    so each item costs the worst-case 46 B (44 B payload + 2 B item
+    header).  The old `8 + 44 * n` budget under-sized exactly this
+    matrix and leaned on the retry path; the corrected cap must fit it
+    first try and still match protobuf byte-for-byte."""
+    n = 64
+    mat = np.full((5, n), -1, np.int64)
+    mat[4] = 0  # error row: no special strings
+    ref = pb.GetRateLimitsResp(responses=[
+        pb.RateLimitResp(status=-1, limit=-1, remaining=-1, reset_time=-1)
+        for _ in range(n)
+    ]).SerializeToString()
+    assert fastwire.encode_resp(mat) == ref
+
+
 def test_parse_resp_roundtrip_and_special():
     mat = np.array(
         [[0, 1], [10, 20], [5, -2], [111, 222], [0, 1]], np.int64

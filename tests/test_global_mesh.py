@@ -340,3 +340,149 @@ def test_sparse_overflow_falls_back_dense():
     s1, s2 = _full_state(dense), _full_state(tiny)
     for name in s1:
         np.testing.assert_array_equal(s1[name], s2[name], err_msg=name)
+
+
+# ----------------------------------------------------------------------
+# Fused probe+reconcile (one envelope gather per step)
+# ----------------------------------------------------------------------
+def _window(eng, rng, keys, width):
+    """One random GLOBAL window: ``width`` requests per node over a
+    ``keys``-key space (width > sparse_k forces envelope overflow)."""
+    return [
+        [
+            req(
+                key=f"fz{int(rng.integers(0, keys))}",
+                hits=int(rng.integers(1, 4)),
+                limit=10_000,
+                behavior=(
+                    Behavior.GLOBAL | Behavior.RESET_REMAINING
+                    if rng.random() < 0.08 else Behavior.GLOBAL
+                ),
+            )
+            for _ in range(width)
+        ]
+        for _ in range(eng.n_nodes)
+    ]
+
+
+def test_fused_sparse_step_parity_fuzz():
+    """The fused program's (overflow bool, gathered envelope, post-step
+    table) must match the unfused two-program path — probe, then sparse
+    step or dense fallback — window for window, including overflowing
+    windows that exercise the fallback."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gubernator_tpu.parallel.global_mesh import (
+        ACC_COUNT,
+        ACC_TOUCH,
+        AUX_ROWS,
+        make_global_overflow_fn,
+        make_global_reconcile_fn,
+        make_global_sparse_step_fn,
+    )
+
+    K = 8
+    eng = MeshGlobalEngine(
+        mesh=make_global_mesh(4), capacity=256, max_batch=64, sparse_k=K)
+    n, cap = eng.n_nodes, eng.capacity
+    # The unfused reference pair (non-donating jits: inputs stay live so
+    # both paths run from identical buffers), plus the strict dense
+    # program the engine itself uses for the fallback.
+    probe = jax.jit(make_global_overflow_fn(eng.mesh, cap, n, K))
+    old_sparse = jax.jit(
+        make_global_reconcile_fn(eng.mesh, cap, n, sparse_k=K))
+    old_dense = jax.jit(make_global_reconcile_fn(eng.mesh, cap, n, True))
+    fused = jax.jit(
+        make_global_sparse_step_fn(eng.mesh, cap, n, K, with_envelope=True))
+
+    NW = 4 + len(AUX_ROWS)
+    rng = np.random.default_rng(3)
+    saw_overflow = saw_sparse = False
+    for w in range(6):
+        width = 20 if w in (2, 4) else 3   # wide windows overflow K=8
+        t = NOW + w * 1000
+        eng.process_blocks(_window(eng, rng, keys=40, width=width), now=t)
+
+        # Unfused reference path.
+        over_old = bool(np.asarray(probe(eng.accum)))
+        st_old, acc_old = (old_dense if over_old else old_sparse)(
+            eng.state, eng.aux, eng.accum, jnp.int64(t))
+
+        # Fused path on the same inputs.
+        st_new, acc_new, over_new, W = fused(
+            eng.state, eng.aux, eng.accum, jnp.int64(t))
+        assert bool(np.asarray(over_new)) == over_old
+        W = np.asarray(W)
+
+        # Envelope contents: the gathered per-node window/touch sets and
+        # probe counts must equal a host-side recomputation from the
+        # accumulators.
+        acc_h = np.asarray(eng.accum)
+        for d in range(n):
+            for row, acc_row in ((0, ACC_COUNT), (NW, ACC_TOUCH)):
+                mask = acc_h[d, acc_row] > 0
+                slots = np.flatnonzero(mask)[:K]
+                want = np.full(K, cap)
+                want[: len(slots)] = slots
+                np.testing.assert_array_equal(
+                    W[d, row], want, err_msg=f"node {d} row {row}")
+            assert W[d, NW + 1, 0] == int((acc_h[d, ACC_COUNT] > 0).sum())
+            assert W[d, NW + 2, 0] == int((acc_h[d, ACC_TOUCH] > 0).sum())
+
+        if over_old:
+            saw_overflow = True
+            # The fused step must hand back untouched buffers for the
+            # host's dense fallback...
+            for a, b in zip(jax.tree.leaves(st_new),
+                            jax.tree.leaves(eng.state)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            np.testing.assert_array_equal(np.asarray(acc_new), acc_h)
+            # ...and fallback-on-returned-buffers equals the old path.
+            st_new, acc_new = old_dense(
+                st_new, eng.aux, acc_new, jnp.int64(t))
+        else:
+            saw_sparse = True
+        for a, b in zip(jax.tree.leaves(st_new), jax.tree.leaves(st_old)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(acc_new),
+                                      np.asarray(acc_old))
+
+        # Advance the engine through its own (fused) reconcile and check
+        # it landed on the same state.
+        eng.reconcile(now=t)
+        for a, b in zip(jax.tree.leaves(eng.state), jax.tree.leaves(st_old)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert saw_overflow and saw_sparse
+
+
+def test_reconcile_dispatch_counter():
+    """One mesh program per non-overflowing sparse step (the fused
+    probe), two for an overflowing step (fused probe + dense fallback) —
+    the counter the bench ladder exports and the regression gate
+    checks."""
+    import numpy as np
+
+    eng = MeshGlobalEngine(
+        mesh=make_global_mesh(4), capacity=256, max_batch=64, sparse_k=8)
+    rng = np.random.default_rng(5)
+
+    eng.process_blocks(_window(eng, rng, keys=40, width=3), now=NOW)
+    d0, f0 = eng.metric_reconcile_dispatches, eng.metric_dense_fallbacks
+    eng.reconcile(now=NOW + 10)
+    assert eng.metric_reconcile_dispatches == d0 + 1
+    assert eng.metric_dense_fallbacks == f0
+
+    eng.process_blocks(_window(eng, rng, keys=40, width=30), now=NOW + 20)
+    eng.reconcile(now=NOW + 30)
+    assert eng.metric_reconcile_dispatches == d0 + 3
+    assert eng.metric_dense_fallbacks == f0 + 1
+
+    # Dense-only engines: one program per step, by construction.
+    dense = MeshGlobalEngine(
+        mesh=make_global_mesh(4), capacity=256, max_batch=32, sparse_k=0)
+    dense.process_blocks(_window(dense, rng, keys=20, width=3), now=NOW)
+    dense.reconcile(now=NOW + 10)
+    assert dense.metric_reconcile_dispatches == 1
+    assert dense.metric_reconciles == 1

@@ -23,6 +23,16 @@ from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
 
 import jax.numpy as jnp
 
+# Interpret-mode emulation of the DMA-ring kernels is version-sensitive
+# (see rowtable.interpret_supported); on jax builds whose interpreter
+# can't lower them these tests would fail on the emulator, not the
+# kernels — real-TPU runs (GUBER_TEST_TPU=1) always execute them.
+pytestmark = pytest.mark.skipif(
+    not rowtable.interpret_supported(),
+    reason="Pallas interpret mode cannot lower the row kernels on this "
+           "jax build",
+)
+
 
 def req(key="k", hits=1, limit=10, duration=60_000, **kw):
     return RateLimitRequest(

@@ -14,8 +14,11 @@ import urllib.request
 
 import grpc
 import pytest
-from cryptography import x509
-from cryptography.hazmat.primitives import serialization
+
+x509 = pytest.importorskip(
+    "cryptography.x509", reason="TLS tests need the cryptography package"
+)
+from cryptography.hazmat.primitives import serialization  # noqa: E402
 
 from gubernator_tpu.config import BehaviorConfig, Config, DaemonConfig, TLSSettings
 from gubernator_tpu.transport.daemon import Daemon, DaemonClient, spawn_daemon
