@@ -549,6 +549,110 @@ def rung_herd(unique_dps, algo, label):
     }
 
 
+def rung_churn(label="engine_churn_4x", capacity=None, ws_mult=4,
+               batch=4096, ticks=None):
+    """Key-churn ladder: working set ``ws_mult``x the device table, with
+    the tiered cold store (docs/tiering.md) absorbing the overflow — the
+    regime where the old blind-zeroing reclaim silently reset every
+    recycled key's budget.  Uniform-random traffic over the working set
+    keeps ~(1 - 1/ws_mult) of each batch cold, so every tick exercises
+    the demote readback AND the batched promote scatter.
+
+    Besides throughput/latency the rung reports the exact work counts
+    the CI gate pins (scripts/check_bench_regression.py COUNT_KEYS):
+
+    * ``churn_continuity_errors`` — probe keys whose consumed budget did
+      NOT survive a hot→cold→hot round trip (must be 0: a fresh-bucket
+      reset is the rate-limit bypass the tier exists to close),
+    * ``promote_dispatches_per_hit_tick`` — restore scatters per tick
+      that had cold hits (must stay 1.0: promotion is one batched
+      scatter, never per-key dispatch),
+    * ``demote_readbacks_per_reclaim`` — readback dispatches per reclaim
+      round with LRU victims (must stay ~1.0: reclaim-free ticks never
+      pay a readback)."""
+    from gubernator_tpu.ops.engine import TickEngine, resolve_ticks
+
+    now = 1_700_000_000_000
+    capacity = capacity or (1 << 13 if FAST else 1 << 16)
+    ticks = ticks or (24 if FAST else 96)
+    n_keys = ws_mult * capacity
+    engine = TickEngine(
+        capacity=capacity, max_batch=batch, cold_capacity=n_keys
+    )
+
+    # Continuity probes: consume budget on keys OUTSIDE the churn id
+    # range, churn them out of the hot tier, then re-touch and check the
+    # budget survived the round trip.
+    n_probe = 8
+    probe_ids = np.arange(10**9, 10**9 + n_probe)
+    engine.process_columns(
+        _cols(probe_ids, 1_000_000, 3_600_000, 0, hits=7), now=now
+    )
+    fill_s = _prefill(engine, n_keys, 0, now, chunk=batch)  # cycles probes cold
+    mat, _ = engine.process_columns(
+        _cols(probe_ids, 1_000_000, 3_600_000, 0, hits=1), now=now
+    )
+    continuity_errors = int(np.sum(mat[2] != 1_000_000 - 7 - 1))
+
+    rng = np.random.default_rng(7)
+    batches = [
+        _cols(rng.integers(0, n_keys, batch), 1_000_000, 3_600_000, 0)
+        for _ in range(min(ticks, 16))
+    ]
+    seg_rates = []
+    tick_i = 0
+    for seg_ticks in [ticks // 3] * 2 + [ticks - 2 * (ticks // 3)]:
+        s0 = time.perf_counter()
+        pending = []
+        for _ in range(seg_ticks):
+            pending.append(
+                engine.submit_columns(batches[tick_i % len(batches)],
+                                      now + tick_i)
+            )
+            tick_i += 1
+            if len(pending) >= 16:
+                resolve_ticks(pending)
+                pending.clear()
+        resolve_ticks(pending)
+        seg_rates.append(
+            seg_ticks * batch / max(time.perf_counter() - s0, 1e-9))
+
+    lat = []
+    for i in range(min(ticks, 48)):
+        t1 = time.perf_counter()
+        engine.process_columns(
+            batches[i % len(batches)], now=now + ticks + i)
+        lat.append((time.perf_counter() - t1) * 1e3)
+    p50, p99 = _pcts(lat)
+    seg = sorted(seg_rates)
+    out = {
+        "rung": label,
+        "keys": n_keys,
+        "capacity": capacity,
+        "batch": batch,
+        "fill_s": round(fill_s, 1),
+        "decisions_per_sec": round(seg[len(seg) // 2], 1),
+        "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "cold_hits": engine.metric_cold_hits,
+        "promotions": engine.metric_promotions,
+        "demotions": engine.cold.metric_demotions,
+        "cold_size": engine.cold_size(),
+        "evictions": engine.metric_unexpired_evictions,
+        # Exact work counts (lower is better; gated without slack).
+        "churn_continuity_errors": continuity_errors,
+        "promote_dispatches_per_hit_tick": round(
+            engine.metric_promote_dispatches
+            / max(1, engine.metric_promote_ticks), 4),
+        "demote_readbacks_per_reclaim": round(
+            engine.metric_demote_readbacks
+            / max(1, engine.metric_evict_reclaims), 4),
+    }
+    engine.close()
+    return out
+
+
 def rung_herd_device():
     """Transport-free herd evidence: chained-``fori_loop`` differential
     ticks (the kernel_1m methodology) for 4096-batch shapes on one
@@ -1548,6 +1652,7 @@ def main():
     ))
 
     ladder.append(_safe("p99_projection", rung_p99_projection))
+    ladder.append(_safe("engine_churn_4x", rung_churn))
     ladder.append(_safe("herd_device", rung_herd_device))
     ladder.append(_safe(
         "herd_token_4096", lambda: rung_herd(unique_dps, 0, "herd_token_4096")
@@ -1718,6 +1823,20 @@ def compact_headline(record, ladder_file):
             head["headline_spread_all"] = r.get("spread_all")
     head["rungs"] = rungs
     head.update(extras)
+    # Exact work-count metrics ride the compact record too (the driver's
+    # tail capture is all the regression gate may get): rung → {key: val}
+    # for every COUNT-gated key present in the full ladder.
+    count_keys = (
+        "dispatches_per_step", "churn_continuity_errors",
+        "promote_dispatches_per_hit_tick", "demote_readbacks_per_reclaim",
+    )
+    count_map = {}
+    for r in record["ladder"]:
+        for k in count_keys:
+            if r.get(k) is not None:
+                count_map.setdefault(r["rung"], {})[k] = r[k]
+    if count_map:
+        head["counts"] = count_map
     if errors:
         head["rung_errors"] = errors
     if record.get("truncated"):

@@ -100,6 +100,11 @@ class Config:
     # thread instead of the serving path): "auto" enables it for tables
     # >= 2^18 slots; "on"/"off" force.  GUBER_TPU_BG_RECLAIM
     tpu_bg_reclaim: str = "auto"
+    # Tiered bucket state (docs/tiering.md): entry budget of the
+    # host-side cold store LRU victims demote into and misses promote
+    # from.  0 disables tiering (eviction destroys bucket state, the
+    # reference's strict LRU semantics).  GUBER_COLD_CACHE_SIZE
+    cold_cache_size: int = 0
     # GLOBAL reconciliation over the device mesh (collectives data plane,
     # parallel/global_mesh.py): N logical peer-nodes; 0 = gRPC loops only.
     # Node index -1 = auto (jax.process_index(), the multi-host identity).
@@ -368,6 +373,7 @@ def setup_daemon_config(
     conf = Config(
         behaviors=behaviors,
         cache_size=r.int_("GUBER_CACHE_SIZE", 50_000),
+        cold_cache_size=r.int_("GUBER_COLD_CACHE_SIZE", 0),
         data_center=r.str_("GUBER_DATA_CENTER"),
         local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
@@ -389,6 +395,10 @@ def setup_daemon_config(
         raise ValueError(
             f"GUBER_TPU_BG_RECLAIM must be auto, on, or off; "
             f"got {conf.tpu_bg_reclaim!r}"
+        )
+    if conf.cold_cache_size < 0:
+        raise ValueError(
+            f"GUBER_COLD_CACHE_SIZE must be >= 0; got {conf.cold_cache_size}"
         )
     validate_global_mesh_capacity(conf.tpu_global_mesh_capacity)
     if conf.local_picker_hash not in ("fnv1", "fnv1a"):

@@ -1975,6 +1975,7 @@ class TickEngine:
         store=None,
         table_layout: str = "auto",
         bg_reclaim: Optional[bool] = None,
+        cold_capacity: int = 0,
     ):
         self.capacity = int(capacity)
         self.max_batch = int(max_batch)
@@ -1982,6 +1983,17 @@ class TickEngine:
         # Write-through costs one extra D2H readback of touched slots per
         # tick; read-through one extra scatter when misses hit the store.
         self.store = store
+        # Tiered bucket state (docs/tiering.md): a host-side cold store
+        # LRU victims demote into (readback-then-evict) and misses
+        # promote out of, so bucket continuity survives hot↔cold cycling
+        # — without it, eviction zeroes the row and a key cycling back
+        # in restarts with a full budget.  0 = disabled (strict
+        # evict-destroys semantics, the reference's lrucache.go:138-149).
+        self.cold = None
+        if cold_capacity > 0:
+            from gubernator_tpu.tiering import ColdStore
+
+            self.cold = ColdStore(int(cold_capacity), store=store)
         self.device = device or jax.devices()[0]
         self.layout = make_layout_choice(
             table_layout, self.capacity, self.device, self.max_batch
@@ -2073,6 +2085,20 @@ class TickEngine:
         self.metric_over_limit = 0
         self.metric_unexpired_evictions = 0
         self.metric_layered_ticks = 0
+        # Tiering telemetry: cold lookups that hit on the miss path,
+        # batched restore scatters the promote path dispatched (and the
+        # ticks that needed one — their ratio must stay 1.0: promotion
+        # is one scatter per tick, never per key), readback dispatches
+        # the demote path ran, and reclaim rounds that had LRU victims
+        # (readbacks happen ONLY inside those).  Shed counts requests
+        # answered with a per-item table-full error instead of a raise.
+        self.metric_cold_hits = 0
+        self.metric_promotions = 0
+        self.metric_promote_dispatches = 0
+        self.metric_promote_ticks = 0
+        self.metric_demote_readbacks = 0
+        self.metric_evict_reclaims = 0
+        self.metric_shed_requests = 0
         self._warmup()
 
     def _warmup(self) -> None:
@@ -2182,7 +2208,12 @@ class TickEngine:
         return slot, known
 
     def _reclaim(self, now: int, want: Optional[int] = None) -> None:
-        """Free expired slots; fall back to LRU eviction (lrucache.go:115-149)."""
+        """Free expired slots; fall back to LRU eviction (lrucache.go:115-149).
+
+        LRU victims take the readback-then-evict path: their rows are
+        pulled D2H *before* the evict scatter and demoted into the cold
+        tier (when one is configured), so unexpired bucket state survives
+        eviction instead of evaporating (docs/tiering.md)."""
         mapped = self.slots.mapped_mask()
         if self._pending:
             mapped[np.fromiter(self._pending, np.int64)] = False
@@ -2195,10 +2226,84 @@ class TickEngine:
         )
         self.slots.release_batch(freed)
         if len(victims) == 0:
+            if self.cold is not None:
+                self.cold.expire(now)
             return
         self.metric_unexpired_evictions += len(victims)
+        finish = self._demote_dispatch(victims, now)
         self.slots.release_batch(victims)
         self.state = evict_chunked(self._evict, self.state, victims, self.capacity)
+        finish()
+        if self.cold is not None:
+            self.cold.expire(now)
+
+    def _demote_dispatch(self, victims: np.ndarray, now: int):
+        """Readback-then-evict, dispatch half: queue the D2H readback of
+        the victim rows *before* the caller's evict scatter (same device
+        stream — program order guarantees the readback observes pre-evict
+        state) and capture the victims' keys before the slot map releases
+        them.  Returns a finish closure that materializes the readback
+        (the D2H wait), lands live rows in the cold tier, and fires
+        ``Store.remove`` for rows leaving the tiered cache entirely — the
+        documented remove-on-eviction contract (store.py) the old blind
+        zeroing never honored.  The background reclaimer runs the closure
+        outside the engine lock; the sync path runs it inline.
+
+        Called only from reclaim rounds that selected LRU victims — a
+        reclaim-free tick never pays a readback."""
+        self.metric_evict_reclaims += 1
+        if self.cold is None and self.store is None:
+            return lambda: None
+        keys = self.slots.keys_batch(victims)
+        if self.cold is None:
+            # No cold tier: eviction is terminal — honor Store.remove
+            # (store.py: "remove on eviction") without any device work.
+            def finish_remove():
+                for k in keys:
+                    if k:
+                        self.store.remove(k.decode())
+
+            return finish_remove
+        pending = []
+        for start in range(0, len(victims), RESTORE_CHUNK):
+            part = victims[start : start + RESTORE_CHUNK]
+            padded = np.full(pad_pow2(len(part)), self.capacity, np.int64)
+            padded[: len(part)] = part
+            self.metric_demote_readbacks += 1
+            pending.append(
+                (len(part), self._readback(self.state, jnp.asarray(padded)))
+            )
+
+        def finish():
+            off = 0
+            for k_n, (ints, floats) in pending:
+                im = np.asarray(ints)[:, :k_n]
+                fl = np.asarray(floats)[:k_n]
+                part_keys = keys[off : off + k_n]
+                off += k_n
+                f = dict(zip(READBACK_ROWS, im))
+                # Rows dead on device (never ticked, or TTL-expired) are
+                # not demoted — resurrecting them would hand the next
+                # tenant stale state; they leave the cache entirely.
+                live = (f["in_use"] != 0) & (f["expire_at"] >= now)
+                sel = np.flatnonzero(live)
+                if len(sel):
+                    cols = {
+                        name: f[name][sel]
+                        for name in READBACK_ROWS
+                        if name != "in_use"
+                    }
+                    cols["remaining_f"] = fl[sel]
+                    self.cold.put_columns(
+                        [part_keys[int(j)] for j in sel], cols, now
+                    )
+                if self.store is not None:
+                    for j in np.flatnonzero(~live):
+                        k = part_keys[int(j)]
+                        if k:
+                            self.store.remove(k.decode())
+
+        return finish
 
     # ------------------------------------------------------------------
     # Background reclaim
@@ -2273,16 +2378,25 @@ class TickEngine:
                 mapped[np.fromiter(self._pending, np.int64)] = False
             la = self._last_access.copy()
         freed, victims = select_reclaim_victims(mapped, dead, la, snap, want)
+        finish = None
         with self._lock:
             freed = freed[self._last_access[freed] <= snap]
             victims = victims[self._last_access[victims] <= snap]
             self.slots.release_batch(freed)
             if len(victims):
                 self.metric_unexpired_evictions += len(victims)
+                # Dispatch the demote readback BEFORE the evict scatter
+                # (device program order = pre-evict state) but run the
+                # D2H wait + cold-tier insert outside the lock.
+                finish = self._demote_dispatch(victims, self._last_now)
                 self.slots.release_batch(victims)
                 self.state = evict_chunked(
                     self._evict, self.state, victims, self.capacity
                 )
+        if finish is not None:
+            finish()
+        if self.cold is not None:
+            self.cold.expire(self._last_now)
 
     def close(self) -> None:
         """Stop the background reclaimer.  Engines are otherwise GC-safe
@@ -2370,7 +2484,27 @@ class TickEngine:
             slots[retry] = s2
             known[retry] = k2
             if (slots < 0).any():
-                raise RuntimeError("rate-limit table full; eviction failed")
+                # Graceful degradation: a truly full table (reclaim freed
+                # nothing — e.g. every slot is pending in this very batch)
+                # sheds the unplaceable items with per-item errors instead
+                # of failing the whole batch (the reference's
+                # error-in-item convention, gubernator.go:208-216); the
+                # rest of the batch is still served.
+                shed = np.flatnonzero(slots < 0)
+                shed_src = shed if sel is None else sel[shed]
+                for j in shed_src:
+                    errors[int(j)] = "rate-limit table full; eviction failed"
+                self.metric_shed_requests += len(shed)
+                keep = slots >= 0
+                sel = (
+                    np.flatnonzero(keep)
+                    if sel is None
+                    else np.asarray(sel)[keep]
+                ).astype(np.int64)
+                slots = slots[keep]
+                known = known[keep]
+                if len(slots) == 0:
+                    return m, n, errors, np.arange(n, dtype=np.int64), False
         self._last_access[slots] = self._tick_count
         miss = known == 0
         self._pending.update(slots[miss].tolist())
@@ -2381,6 +2515,9 @@ class TickEngine:
             # Insert pressure near a full table: reclaim in the background
             # so the dead-scan/argpartition never lands on a serving tick.
             self._maybe_trigger_reclaim()
+
+        if self.cold is not None and miss.any():
+            miss = self._promote_misses(cols, sel, slots, known, miss, now)
 
         if self.store is not None and miss.any():
             if cols.refs is None:
@@ -2430,6 +2567,56 @@ class TickEngine:
             ((sl[1:] == sl[:-1]) & (sl[1:] < self.capacity)).any()
         )
         return m, n, errors, inv, has_dups
+
+    def _promote_misses(
+        self, cols: ReqColumns, sel, slots, known, miss, now: int
+    ) -> np.ndarray:
+        """Consult the cold tier for this batch's misses and batch-reinstall
+        the hits via ONE restore scatter before the tick runs — the
+        promote half of the tiering flow (docs/tiering.md).  Promotion is
+        a move: the cold tier drops its copy, the device row becomes the
+        owner, and the request proceeds as a *known* slot so the bucket
+        keeps its consumed budget (no fresh-bucket bypass).  Returns the
+        updated miss mask (read-through only sees what stayed cold-miss).
+
+        Duplicate keys in one batch resolve to one miss row (the slot
+        map marks later occurrences known), so hit rows map to unique
+        slots and the single scatter has no write conflicts."""
+        midx = np.flatnonzero(miss)
+        src = midx if sel is None else np.asarray(sel)[midx]
+        pos, ccols = self.cold.take(
+            [cols.key_bytes(int(j)) for j in src], now
+        )
+        if len(pos) == 0:
+            return miss
+        hit_rows = midx[pos]
+        self.metric_cold_hits += len(hit_rows)
+        known[hit_rows] = 1
+        hit_slots = slots[hit_rows]
+        # The restore lands the device rows right here, so these slots
+        # are live (in_use set) before the tick — no longer pending.
+        self._pending.difference_update(int(s) for s in hit_slots)
+        self._dirty[hit_slots] = True
+        # One batched scatter for the whole tick's promotions (chunked
+        # only past RESTORE_CHUNK, which a ≤max_batch tick never is).
+        for start in range(0, len(hit_rows), RESTORE_CHUNK):
+            part = slice(start, start + RESTORE_CHUNK)
+            k = len(hit_slots[part])
+            w = pad_pow2(k)
+            ints = np.zeros((len(ITEM_INT_ROWS), w), np.int64)
+            floats = np.zeros(w, np.float64)
+            ints[0, :k] = hit_slots[part]
+            for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
+                ints[r, :k] = ccols[name][part]
+            ints[-1, :k] = 1  # valid
+            floats[:k] = ccols["remaining_f"][part]
+            self.state = self._restore(
+                self.state, jnp.asarray(ints), jnp.asarray(floats)
+            )
+            self.metric_promote_dispatches += 1
+        self.metric_promote_ticks += 1
+        self.metric_promotions += len(hit_rows)
+        return known == 0
 
     def _read_through(self, requests, sel, slots, known, miss) -> None:
         """Store.Get for cache misses (algorithms.go:45-51): install the
@@ -2790,7 +2977,7 @@ class TickEngine:
             if n == 0:
                 self.last_export_stats = {
                     "d2h_bytes": 0, "items": 0, "partial": dirty_only}
-                return empty
+                return self._export_with_cold(empty, dirty_only)
             w = SNAP_CHUNK if n > SNAP_CHUNK else pad_pow2(n)
             wide_fn = _jitted_snap_wide(self.layout)
             probe_fn = _jitted_snap_probe()
@@ -2829,7 +3016,7 @@ class TickEngine:
             if len(live) == 0:
                 self.last_export_stats = {
                     "d2h_bytes": d2h, "items": 0, "partial": dirty_only}
-                return empty
+                return self._export_with_cold(empty, dirty_only)
             blob, offsets = self.slots.keys_blob(live)
             snap: dict = {"key_blob": blob, "key_offsets": offsets}
             for name in SNAP_FIELDS:
@@ -2840,7 +3027,33 @@ class TickEngine:
                 "bytes_per_item": round(d2h / max(len(live), 1), 1),
                 "partial": dirty_only,
             }
+            return self._export_with_cold(snap, dirty_only)
+
+    def _export_with_cold(self, snap: dict, dirty_only: bool) -> dict:
+        """Append the cold tier's (dirty) entries to a columnar snapshot:
+        demoted state is still cached state and must survive a Loader
+        save/restore cycle (docs/tiering.md).  Hot and cold are disjoint
+        by construction (promotion is a move), so the merge is a plain
+        concatenation — no dedup pass."""
+        if self.cold is None:
             return snap
+        ckeys, ccols = self.cold.export_columns(dirty_only)
+        if not ckeys:
+            return snap
+        from gubernator_tpu.ops.reqcols import pack_blob
+
+        blob2, offs2 = pack_blob(ckeys)
+        off1 = np.asarray(snap["key_offsets"], np.int64)
+        base = int(off1[-1]) if len(off1) else 0
+        snap["key_blob"] = bytes(snap["key_blob"]) + blob2
+        snap["key_offsets"] = np.concatenate([off1, offs2[1:] + base])
+        for f in SNAP_FIELDS:
+            snap[f] = np.concatenate([np.asarray(snap[f]), ccols[f]])
+        self.last_export_stats["items"] = (
+            self.last_export_stats.get("items", 0) + len(ckeys)
+        )
+        self.last_export_stats["cold_items"] = len(ckeys)
+        return snap
 
     def export_items(self) -> List[dict]:
         """Drain live bucket state to host dicts (the dict-shaped Loader
@@ -2876,6 +3089,18 @@ class TickEngine:
             if shortfall > 0:
                 self._reclaim(now, want=shortfall)
             slots = self.slots.assign_blob(blob, offsets)
+            if self.cold is not None and (slots < 0).any():
+                # Full table: the overflow tail lands in the cold tier
+                # instead of being dropped — a restore bigger than the
+                # device table keeps the whole working set (the miss
+                # path promotes rows back as traffic touches them).
+                over = np.flatnonzero(slots < 0)
+                offsets = np.asarray(offsets, np.int64)
+                self.cold.put_columns(
+                    [bytes(blob[offsets[j] : offsets[j + 1]]) for j in over],
+                    {f: cols[f][over] for f in SNAP_FIELDS},
+                    now,
+                )
             sel = np.flatnonzero(slots >= 0)  # full table: drop the tail
             if len(sel) == 0:
                 return
@@ -2916,3 +3141,12 @@ class TickEngine:
 
     def cache_size(self) -> int:
         return len(self.slots)
+
+    def cold_size(self) -> int:
+        """Entries currently held by the cold tier (0 when tiering is
+        disabled) — the occupancy gauge's second axis."""
+        return 0 if self.cold is None else len(self.cold)
+
+    def hot_occupancy(self) -> float:
+        """Fraction of device slots holding a mapped key (0.0–1.0)."""
+        return len(self.slots) / self.capacity if self.capacity else 0.0

@@ -70,6 +70,7 @@ class InstanceConfig:
     tpu_platform: str = ""               # force jax platform ("cpu" for tests)
     tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
     tpu_bg_reclaim: str = "auto"         # background reclamation (engine.py)
+    cold_cache_size: int = 0             # tiered cold store (docs/tiering.md)
     # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
     # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
     # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
@@ -97,6 +98,7 @@ class InstanceConfig:
             tpu_platform=conf.tpu_platform,
             tpu_table_layout=conf.tpu_table_layout,
             tpu_bg_reclaim=conf.tpu_bg_reclaim,
+            cold_cache_size=conf.cold_cache_size,
             tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
             tpu_global_mesh_node=conf.tpu_global_mesh_node,
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
@@ -116,6 +118,11 @@ def _make_engine(conf: InstanceConfig):
     if conf.tpu_mesh_shards > 1:
         from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
 
+        if conf.cold_cache_size:
+            log.warning(
+                "GUBER_COLD_CACHE_SIZE is not supported by the sharded "
+                "mesh engine yet; tiering disabled"
+            )
         devices = jax.devices()[: conf.tpu_mesh_shards]
         local_cap = max(1, conf.cache_size // len(devices))
         return MeshTickEngine(
@@ -134,6 +141,7 @@ def _make_engine(conf: InstanceConfig):
         store=conf.store,
         table_layout=conf.tpu_table_layout,
         bg_reclaim=bg,
+        cold_capacity=conf.cold_cache_size,
     )
 
 
@@ -645,6 +653,20 @@ class V1Instance:
             message="|".join(errs),
             peer_count=len(local_peers) + len(region_peers),
         )
+
+    def occupancy(self) -> dict:
+        """Tier occupancy snapshot (docs/tiering.md): device-table fill,
+        cold-store size, and shed count — surfaced by the daemon's
+        /healthz JSON and mirrored into the Prometheus gauges."""
+        eng = self.engine
+        return {
+            "cache_size": eng.cache_size(),
+            "hot_occupancy": round(
+                getattr(eng, "hot_occupancy", lambda: 0.0)(), 4
+            ),
+            "cold_size": getattr(eng, "cold_size", lambda: 0)(),
+            "shed_requests": getattr(eng, "metric_shed_requests", 0),
+        }
 
     def set_peers(self, peer_info: Sequence[PeerInfo]) -> None:
         """Install a new peer set (gubernator.go:616-711): reuse existing

@@ -87,6 +87,10 @@ class TickLoop:
         self._synced_hits = 0
         self._synced_misses = 0
         self._synced_unexpired = 0
+        self._synced_cold_hits = 0
+        self._synced_promotions = 0
+        self._synced_demotions = 0
+        self._synced_shed = 0
         self._cond = threading.Condition()
         self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
@@ -320,6 +324,30 @@ class TickLoop:
         if unexp > self._synced_unexpired:
             m.unexpired_evictions.inc(unexp - self._synced_unexpired)
             self._synced_unexpired = unexp
+        # Tiering families (docs/tiering.md).  Counters sync as deltas
+        # like the cache families above; the occupancy gauges are set
+        # directly (they are levels, not flows).
+        cold_hits = getattr(self.engine, "metric_cold_hits", 0)
+        promos = getattr(self.engine, "metric_promotions", 0)
+        shed = getattr(self.engine, "metric_shed_requests", 0)
+        cold = getattr(self.engine, "cold", None)
+        if cold_hits > self._synced_cold_hits:
+            m.cold_hits.inc(cold_hits - self._synced_cold_hits)
+            self._synced_cold_hits = cold_hits
+        if promos > self._synced_promotions:
+            m.cold_promotions.inc(promos - self._synced_promotions)
+            self._synced_promotions = promos
+        if shed > self._synced_shed:
+            m.shed_requests.inc(shed - self._synced_shed)
+            self._synced_shed = shed
+        if cold is not None:
+            demos = cold.metric_demotions
+            if demos > self._synced_demotions:
+                m.cold_demotions.inc(demos - self._synced_demotions)
+                self._synced_demotions = demos
+            m.cold_size.set(len(cold))
+        if hasattr(self.engine, "hot_occupancy"):
+            m.hot_occupancy.set(self.engine.hot_occupancy())
 
     def _drain_resolve_q(self, err: Exception) -> None:
         """Fail every window still queued for resolution.  A drained None

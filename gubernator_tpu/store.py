@@ -33,10 +33,19 @@ from gubernator_tpu.types import RateLimitRequest
 
 
 class Store(Protocol):
-    """Write-through/read-through hooks (reference store.go:49-65)."""
+    """Write-through/read-through hooks (reference store.go:49-65).
 
-    def on_change(self, req: RateLimitRequest, item: dict) -> None:
-        """Called after every mutation with the full bucket state."""
+    With tiered bucket state enabled (docs/tiering.md) the Store is also
+    the cold tier's **write-behind** sink: when the bounded cold store
+    sheds an entry to make room, it calls ``on_change(None, item)`` —
+    ``req`` is None because no request drove the flush — so a third
+    durability tier can absorb what the host tier drops.  ``remove`` is
+    fired when an item leaves the tiered cache entirely: hot-tier
+    eviction with no cold tier configured, or cold-tier TTL expiry."""
+
+    def on_change(self, req: Optional[RateLimitRequest], item: dict) -> None:
+        """Called after every mutation with the full bucket state (and
+        with ``req=None`` for cold-tier write-behind flushes)."""
 
     def get(self, req: RateLimitRequest) -> Optional[dict]:
         """Called on cache miss; return the persisted item or None."""

@@ -1,0 +1,14 @@
+"""Tiered bucket state: the host-side cold tier between HBM and a Store.
+
+The engine's device table (L1) is fixed-capacity; before this package,
+LRU reclaim *destroyed* victim rows (the evict scatter zeroes them), so
+any key cycling out and back in restarted with a full budget — a
+rate-limit bypass under churn.  The cold tier is a bounded host-side
+columnar store the engine demotes victims into (readback-then-evict)
+and promotes misses out of (one batched restore scatter per tick), so
+bucket continuity survives hot↔cold cycling.  See docs/tiering.md.
+"""
+
+from gubernator_tpu.tiering.coldstore import ColdStore
+
+__all__ = ["ColdStore"]

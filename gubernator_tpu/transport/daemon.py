@@ -420,16 +420,23 @@ class Daemon:
         msg = pb.HealthCheckResp(
             status=h.status, message=h.message, peer_count=h.peer_count
         )
-        return web.json_response(
-            json_format.MessageToDict(
-                msg,
-                preserving_proto_field_name=True,
-                always_print_fields_with_no_presence=True,
-            )
+        body = json_format.MessageToDict(
+            msg,
+            preserving_proto_field_name=True,
+            always_print_fields_with_no_presence=True,
         )
+        # Tier occupancy rides the health JSON as extra keys (the proto
+        # message is unchanged — wire-compatible clients ignore them).
+        body["occupancy"] = self.instance.occupancy()
+        return web.json_response(body)
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
-        self.metrics.cache_size.set(self.instance.engine.cache_size())
+        eng = self.instance.engine
+        self.metrics.cache_size.set(eng.cache_size())
+        if hasattr(eng, "hot_occupancy"):
+            self.metrics.hot_occupancy.set(eng.hot_occupancy())
+        if hasattr(eng, "cold_size"):
+            self.metrics.cold_size.set(eng.cold_size())
         return web.Response(
             body=self.metrics.expose(), content_type="text/plain"
         )

@@ -203,6 +203,46 @@ class Metrics:
             registry=reg,
         )
 
+        # Tiered bucket state (docs/tiering.md): demote/promote traffic
+        # between the device table and the host-side cold store, tier
+        # occupancy, and requests shed with per-item errors when the
+        # table is truly full (eviction freed nothing).
+        self.cold_demotions = Counter(
+            "gubernator_tpu_cold_demotions",
+            "Bucket rows demoted from the device table into the "
+            "host-side cold store (readback-then-evict).",
+            registry=reg,
+        )
+        self.cold_promotions = Counter(
+            "gubernator_tpu_cold_promotions",
+            "Bucket rows promoted from the cold store back into the "
+            "device table (batched restore scatter on the miss path).",
+            registry=reg,
+        )
+        self.cold_hits = Counter(
+            "gubernator_tpu_cold_hits",
+            "Cache misses that found their bucket in the cold store.",
+            registry=reg,
+        )
+        self.cold_size = Gauge(
+            "gubernator_tpu_cold_size",
+            "The number of entries currently held by the cold store.",
+            registry=reg,
+        )
+        self.hot_occupancy = Gauge(
+            "gubernator_tpu_hot_occupancy",
+            "Fraction of device bucket-table slots holding a mapped key "
+            "(0.0-1.0).",
+            registry=reg,
+        )
+        self.shed_requests = Counter(
+            "gubernator_tpu_shed_requests",
+            "Requests answered with a per-item 'table full' error "
+            "because the table was full and eviction freed nothing "
+            "(the rest of their batch was still served).",
+            registry=reg,
+        )
+
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
         (reference flags.go:20-23 + daemon.go:276-287).  "os" → process

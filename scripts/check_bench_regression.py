@@ -25,7 +25,22 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 # a candidate exceeding its baseline re-introduced dispatch work — e.g.
 # un-fusing the sparse reconcile's overflow probe doubles
 # dispatches_per_step from 1.0 to 2.0.  Gated without spread slack.
-COUNT_KEYS = ("dispatches_per_step",)
+# The churn-ladder keys pin the tiering invariants (docs/tiering.md):
+#   churn_continuity_errors        0   — re-promoted keys keep their
+#                                        consumed budget (no fresh-bucket
+#                                        rate-limit bypass under churn)
+#   promote_dispatches_per_hit_tick 1.0 — cold-hit promotion stays ONE
+#                                        batched restore scatter per tick,
+#                                        never a per-key dispatch
+#   demote_readbacks_per_reclaim   1.0 — the demote readback runs only in
+#                                        reclaim rounds with LRU victims;
+#                                        reclaim-free ticks never pay it
+COUNT_KEYS = (
+    "dispatches_per_step",
+    "churn_continuity_errors",
+    "promote_dispatches_per_hit_tick",
+    "demote_readbacks_per_reclaim",
+)
 
 
 def load_bench(path):
@@ -110,6 +125,12 @@ def counts(doc):
         for k in COUNT_KEYS:
             if rung.get(k) is not None:
                 out[(rung["rung"], k)] = float(rung[k])
+    # Compact headline records carry the same counts under "counts"
+    # (rung → {key: value}) — the full ladder wins on conflicts.
+    for name, kv in doc.get("counts", {}).items():
+        for k, v in kv.items():
+            if k in COUNT_KEYS and v is not None:
+                out.setdefault((name, k), float(v))
     return out
 
 
@@ -200,6 +221,17 @@ def main():
             failed = True
         print(f"  {name}: {b:g} -> {c:g} (count, lower is better, {mark})")
     for key in sorted(set(base_counts) ^ set(cand_counts)):
+        if key in cand_counts and key[1] == "churn_continuity_errors":
+            # Absolute invariant — a re-promoted key losing its consumed
+            # budget is a rate-limit bypass, baseline rung or not.
+            gated += 1
+            v = cand_counts[key]
+            mark = "FAIL" if v > 0 else "ok"
+            if v > 0:
+                failed = True
+            print(f"  {key[0]}.{key[1]}: {v:g} "
+                  f"(absolute invariant, must be 0, {mark})")
+            continue
         side = "candidate" if key not in base_counts else "baseline"
         print(f"  {key[0]}.{key[1]}: only in {side} — not gated")
     if gated == 0 and not args.allow_empty:

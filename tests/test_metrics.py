@@ -157,3 +157,21 @@ async def test_grpc_max_conn_age_env():
         await c.close()
     finally:
         await d.close()
+
+
+def test_tiering_families_registered():
+    # docs/tiering.md observability table: the tiering counters/gauges
+    # exist from construction so dashboards see zeroes, not absences.
+    m = Metrics()
+    m.cold_demotions.inc(3)
+    m.cold_promotions.inc(2)
+    m.cold_hits.inc(2)
+    m.cold_size.set(1)
+    m.hot_occupancy.set(0.5)
+    m.shed_requests.inc()
+    assert m.sample("gubernator_tpu_cold_demotions_total") == 3
+    assert m.sample("gubernator_tpu_cold_promotions_total") == 2
+    assert m.sample("gubernator_tpu_cold_hits_total") == 2
+    assert m.sample("gubernator_tpu_cold_size") == 1
+    assert m.sample("gubernator_tpu_hot_occupancy") == 0.5
+    assert m.sample("gubernator_tpu_shed_requests_total") == 1
