@@ -1249,6 +1249,94 @@ def rung_service():
 
 
 # ----------------------------------------------------------------------
+# Chaos rung: partition the GLOBAL owner, then prove zero hit loss
+# ----------------------------------------------------------------------
+async def _chaos_bench():
+    """Fault-injected 2-daemon cluster (docs/resilience.md): the GLOBAL
+    owner runs at 100% injected RPC failure while a non-owner serves
+    degraded local answers and buffers hits; after recovery every hit
+    must land on the owner.  ``hit_redelivery_loss`` is the exact count
+    of hits that failed to land — check_bench_regression.py gates it at
+    0 absolutely (a lost hit is lost accounting, baseline or not)."""
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.resilience import FaultInjector, ResilienceConfig
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    behaviors = BehaviorConfig(global_sync_wait=0.02, batch_wait=0.001)
+    resilience = ResilienceConfig(
+        breaker_open_for=0.05, breaker_open_cap=0.1, breaker_min_requests=3,
+    )
+    inj = FaultInjector(seed=7)
+    c = await Cluster.start(2, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaosbench", "ck"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        ni = c.daemons.index(non_owner)
+        owner_addr = owner.conf.grpc_listen_address
+        inj.set_fault(owner_addr, partition=True)
+
+        def greq(hits):
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=1_000_000,
+                duration=3_600_000, behavior=Behavior.GLOBAL,
+            )
+
+        client = non_owner.client()
+        n_req = 50 if FAST else 300
+        sent = 0
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            out = await client.get_rate_limits([greq(1)])
+            if out[0].error:
+                raise RuntimeError(f"degraded answer errored: {out[0].error}")
+            sent += 1
+        degraded_dt = time.perf_counter() - t0
+        await client.close()
+
+        inj.clear()
+        oc = owner.client()
+        landed = 0
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline:
+            r = (await oc.get_rate_limits([greq(0)]))[0]
+            landed = 1_000_000 - r.remaining
+            if landed == sent:
+                break
+            await asyncio.sleep(0.02)
+        await oc.close()
+
+        m = non_owner.metrics
+        loops_alive = all(
+            not t.done() for t in non_owner.instance.global_mgr._tasks
+        )
+        return {
+            "rung": "chaos_redelivery",
+            # Degraded-mode serving rate: local answers while the owner
+            # is 100% unavailable (bounded degradation, not an outage).
+            "requests_per_sec": round(sent / degraded_dt, 1),
+            "hits_sent": sent,
+            "hits_landed": int(landed),
+            "hit_redelivery_loss": int(sent - landed),
+            "redelivered_hits": m.sample(
+                "gubernator_global_redelivered_hits_total"),
+            "dropped_hits": m.sample("gubernator_global_dropped_hits_total"),
+            "breaker_opens": m.sample(
+                "gubernator_breaker_transitions_total",
+                {"peerAddr": owner_addr, "to": "open"}),
+            "loops_alive": loops_alive,
+        }
+    finally:
+        await c.stop()
+
+
+def rung_chaos():
+    return asyncio.run(_chaos_bench())
+
+
+# ----------------------------------------------------------------------
 # Sharded-table mesh rung (8 virtual devices, CPU backend, subprocess)
 # ----------------------------------------------------------------------
 def child_mesh_tick():
@@ -1677,6 +1765,7 @@ def main():
         ladder.append(_safe("engine_100m_drain_reset_region", rung_100m))
 
     ladder.append(_safe("service_grpc", rung_service))
+    ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
@@ -1829,6 +1918,7 @@ def compact_headline(record, ladder_file):
     count_keys = (
         "dispatches_per_step", "churn_continuity_errors",
         "promote_dispatches_per_hit_tick", "demote_readbacks_per_reclaim",
+        "hit_redelivery_loss",
     )
     count_map = {}
     for r in record["ladder"]:

@@ -26,6 +26,8 @@ def _daemon_config(
     datacenter: str = "",
     behaviors: Optional[BehaviorConfig] = None,
     cache_size: int = 4096,
+    resilience=None,
+    fault_injector=None,
 ) -> DaemonConfig:
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
@@ -38,6 +40,9 @@ def _daemon_config(
         cache_size=cache_size,
         data_center=datacenter,
     )
+    if resilience is not None:
+        conf.config.resilience = resilience
+    conf.config.fault_injector = fault_injector
     return conf
 
 
@@ -58,6 +63,8 @@ class Cluster:
         cache_size: int = 4096,
         http_gateway: bool = False,
         global_mesh: bool = False,
+        resilience=None,
+        fault_injector=None,
     ) -> "Cluster":
         """Boot ``n`` daemons (dc layout via ``datacenters``, one entry per
         daemon) and wire them into one cluster (cluster.go:123-189).
@@ -65,6 +72,10 @@ class Cluster:
         ``global_mesh=True`` models mesh-resident peers: all daemons share
         one MeshGlobalEngine (one device per daemon) so GLOBAL limits
         reconcile via collectives instead of the gRPC loops.
+
+        ``resilience``/``fault_injector`` thread the fault-tolerant peer
+        path's knobs and the chaos hook into every daemon (the injector is
+        shared, so one schedule partitions a peer cluster-wide).
         """
         c = cls()
         datacenters = list(datacenters or [""] * n)
@@ -83,7 +94,8 @@ class Cluster:
                 min_reconcile_ms=sync_ms,
             )
         for idx, dc in enumerate(datacenters):
-            conf = _daemon_config(dc, behaviors, cache_size)
+            conf = _daemon_config(dc, behaviors, cache_size,
+                                  resilience, fault_injector)
             if http_gateway:
                 conf.http_listen_address = "127.0.0.1:0"
             d = Daemon(conf, global_mesh=mesh_engine, global_mesh_node=idx)
@@ -143,6 +155,8 @@ class Cluster:
             old.conf.data_center,
             old.conf.config.behaviors,
             old.conf.config.cache_size,
+            old.conf.config.resilience,
+            old.conf.config.fault_injector,
         )
         conf.grpc_listen_address = addr
         d = Daemon(conf)

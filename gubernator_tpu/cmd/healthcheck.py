@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import urllib.error
 import urllib.request
 
 
@@ -24,6 +25,15 @@ def main(argv=None) -> int:
     try:
         with urllib.request.urlopen(url, timeout=5) as resp:
             body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # The daemon answers 503 with the health JSON body when unhealthy
+        # (e.g. a majority of peers behind open circuit breakers) —
+        # surface its message instead of the bare HTTP error.
+        try:
+            body = json.loads(e.read())
+        except Exception:
+            print(f"healthcheck failed: {e}", file=sys.stderr)
+            return 2
     except Exception as e:
         print(f"healthcheck failed: {e}", file=sys.stderr)
         return 2

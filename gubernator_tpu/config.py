@@ -24,6 +24,7 @@ import string
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from gubernator_tpu.resilience import ResilienceConfig
 from gubernator_tpu.types import PeerInfo
 
 log = logging.getLogger("gubernator")
@@ -111,6 +112,15 @@ class Config:
     tpu_global_mesh_nodes: int = 0
     tpu_global_mesh_node: int = -1
     tpu_global_mesh_capacity: int = 1 << 16
+
+    # Fault-tolerant peer path (docs/resilience.md): per-peer circuit
+    # breakers, forward-retry backoff, and the GLOBAL redelivery buffer.
+    # GUBER_BREAKER_* / GUBER_FORWARD_* / GUBER_REDELIVERY_LIMIT.
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    # Fault-injection hook (chaos tests / game-days): a FaultInjector the
+    # peer clients consult before every RPC.  GUBER_FAULT_* builds one at
+    # daemon setup; tests install theirs directly.
+    fault_injector: Optional[object] = None
 
     # Optional persistence hooks (reference store.go).
     loader: Optional[object] = None
@@ -370,8 +380,29 @@ def setup_daemon_config(
         global_batch_limit=r.int_("GUBER_GLOBAL_BATCH_LIMIT", 1000),
         force_global=r.bool_("GUBER_FORCE_GLOBAL"),
     )
+    resilience = ResilienceConfig(
+        breaker_enabled=r.bool_("GUBER_BREAKER_ENABLED", True),
+        breaker_failure_threshold=float(
+            r.str_("GUBER_BREAKER_FAILURE_THRESHOLD", "0.5")
+        ),
+        breaker_min_requests=r.int_("GUBER_BREAKER_MIN_REQUESTS", 5),
+        breaker_window=r.float_seconds("GUBER_BREAKER_WINDOW", 10.0),
+        breaker_open_for=r.float_seconds("GUBER_BREAKER_OPEN_FOR", 2.0),
+        breaker_open_cap=r.float_seconds("GUBER_BREAKER_OPEN_CAP", 30.0),
+        breaker_half_open_probes=r.int_("GUBER_BREAKER_HALF_OPEN_PROBES", 1),
+        forward_max_attempts=r.int_("GUBER_FORWARD_MAX_ATTEMPTS", 5),
+        forward_backoff_base=r.float_seconds(
+            "GUBER_FORWARD_BACKOFF_BASE", 0.005
+        ),
+        forward_backoff_cap=r.float_seconds("GUBER_FORWARD_BACKOFF_CAP", 0.1),
+        redelivery_limit=r.int_("GUBER_REDELIVERY_LIMIT", 10_000),
+    )
+    from gubernator_tpu.resilience import FaultInjector
+
     conf = Config(
         behaviors=behaviors,
+        resilience=resilience,
+        fault_injector=FaultInjector.from_env(r),
         cache_size=r.int_("GUBER_CACHE_SIZE", 50_000),
         cold_cache_size=r.int_("GUBER_COLD_CACHE_SIZE", 0),
         data_center=r.str_("GUBER_DATA_CENTER"),
@@ -399,6 +430,21 @@ def setup_daemon_config(
     if conf.cold_cache_size < 0:
         raise ValueError(
             f"GUBER_COLD_CACHE_SIZE must be >= 0; got {conf.cold_cache_size}"
+        )
+    if not 0.0 < resilience.breaker_failure_threshold <= 1.0:
+        raise ValueError(
+            f"GUBER_BREAKER_FAILURE_THRESHOLD must be in (0, 1]; "
+            f"got {resilience.breaker_failure_threshold}"
+        )
+    if resilience.forward_max_attempts < 0:
+        raise ValueError(
+            f"GUBER_FORWARD_MAX_ATTEMPTS must be >= 0; "
+            f"got {resilience.forward_max_attempts}"
+        )
+    if resilience.redelivery_limit < 0:
+        raise ValueError(
+            f"GUBER_REDELIVERY_LIMIT must be >= 0; "
+            f"got {resilience.redelivery_limit}"
         )
     validate_global_mesh_capacity(conf.tpu_global_mesh_capacity)
     if conf.local_picker_hash not in ("fnv1", "fnv1a"):

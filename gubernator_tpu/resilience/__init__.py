@@ -1,0 +1,74 @@
+"""Fault-tolerant peer path: breakers, backoff, redelivery, fault injection.
+
+The peer path's failure story used to be "swallow and hope": failed GLOBAL
+hit flushes and broadcasts were dropped, a crashed background loop stayed
+dead, and forwarded requests retried in a tight fixed-count loop.  This
+package holds the building blocks that replace that:
+
+* :class:`CircuitBreaker` — per-peer closed/open/half-open breaker over a
+  sliding failure window; an open breaker fails fast without dialing.
+* :class:`DecorrelatedJitterBackoff` — AWS-style decorrelated jitter for
+  forward retries and breaker open durations.
+* :class:`FaultInjector` — seedable per-peer drop/delay/error/partition
+  schedules for the chaos suite and staged game-days (``GUBER_FAULT_*``).
+* :func:`spawn_supervised` — crash-proof wrapper for the background loops
+  (GLOBAL hits, broadcast, peer batch): log, count, restart.
+* :class:`ManualClock` — virtual time for tests (no real sleeps).
+
+Wiring: ``PeerClient`` owns one breaker per peer and consults the injector
+before every RPC; ``GlobalManager`` re-enqueues failed batches into its
+bounded redelivery buffer; ``V1Instance._async_request`` retries with
+backoff and degrades GLOBAL keys to the local non-owner answer when the
+owner's breaker is open.  See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from gubernator_tpu.resilience.backoff import DecorrelatedJitterBackoff
+from gubernator_tpu.resilience.breaker import (
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+)
+from gubernator_tpu.resilience.clock import ManualClock
+from gubernator_tpu.resilience.faults import FaultInjector, FaultSpec
+from gubernator_tpu.resilience.supervisor import spawn_supervised
+
+
+@dataclass
+class ResilienceConfig:
+    """Knobs for the fault-tolerant peer path (env surface ``GUBER_BREAKER_*``,
+    ``GUBER_FORWARD_*``, ``GUBER_REDELIVERY_LIMIT``; see config.py)."""
+
+    # Per-peer circuit breaker.
+    breaker_enabled: bool = True
+    breaker_failure_threshold: float = 0.5   # failure rate that trips
+    breaker_min_requests: int = 5            # volume floor inside the window
+    breaker_window: float = 10.0             # sliding window (seconds)
+    breaker_open_for: float = 2.0            # base open duration (backoff base)
+    breaker_open_cap: float = 30.0           # open-duration backoff cap
+    breaker_half_open_probes: int = 1        # RPCs allowed through half-open
+
+    # Forward retry loop (V1Instance._async_request).
+    forward_max_attempts: int = 5
+    forward_backoff_base: float = 0.005
+    forward_backoff_cap: float = 0.1
+
+    # GLOBAL redelivery buffer: max distinct keys held for re-flush after a
+    # failed send/broadcast (beyond it, records drop and are counted).
+    redelivery_limit: int = 10_000
+
+
+__all__ = [
+    "BreakerOpenError",
+    "BreakerState",
+    "CircuitBreaker",
+    "DecorrelatedJitterBackoff",
+    "FaultInjector",
+    "FaultSpec",
+    "ManualClock",
+    "ResilienceConfig",
+    "spawn_supervised",
+]

@@ -1,0 +1,139 @@
+"""Seedable per-peer fault injection for the peer RPC path.
+
+A :class:`FaultInjector` sits between ``PeerClient`` and its gRPC stub
+(wired through ``InstanceConfig.fault_injector`` — a test/config hook, not
+a hot-path feature): before every peer RPC the client awaits
+``before_rpc(peer, method)``, which may delay the call, raise UNAVAILABLE
+(``error``/``partition``), or raise DEADLINE_EXCEEDED (``drop`` — a
+dropped RPC surfaces to the caller as its deadline expiring).  Faults are
+keyed per peer address (``"*"`` matches every peer), draws come from a
+seeded RNG so chaos runs replay exactly, and injected faults are counted
+per (peer, kind) for test oracles.
+
+The env surface (``GUBER_FAULT_*``, see :meth:`FaultInjector.from_env`)
+lets an operator stage the same schedules in a real deployment.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import grpc
+import grpc.aio
+
+
+def rpc_error(code: grpc.StatusCode, details: str) -> grpc.aio.AioRpcError:
+    """A real AioRpcError (so retry/breaker paths can't tell it from the
+    wire) carrying the injected status."""
+    return grpc.aio.AioRpcError(
+        code,
+        grpc.aio.Metadata(),
+        grpc.aio.Metadata(),
+        details=details,
+        debug_error_string="fault-injected",
+    )
+
+
+@dataclass
+class FaultSpec:
+    """One peer's fault schedule.  Rates are probabilities per RPC."""
+
+    error_rate: float = 0.0      # UNAVAILABLE with this probability
+    drop_rate: float = 0.0       # DEADLINE_EXCEEDED with this probability
+    delay: float = 0.0           # fixed latency added before the RPC
+    partition: bool = False      # unconditional UNAVAILABLE (100% failure)
+    methods: Tuple[str, ...] = ()  # restrict to these RPCs; empty = all
+
+    def matches(self, method: str) -> bool:
+        return not self.methods or method in self.methods
+
+
+class FaultInjector:
+    """Per-peer fault schedules with a seeded RNG and virtual-clock hooks."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep=asyncio.sleep,
+    ):
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._sleep = sleep
+        self._faults: Dict[str, FaultSpec] = {}
+        # (peer, kind) → count; kind in {"error", "drop", "delay"}.
+        self.injected: collections.Counter = collections.Counter()
+
+    # ------------------------------------------------------------------
+    def set_fault(self, peer: str = "*", **spec) -> FaultSpec:
+        """Install/replace the schedule for ``peer`` (``"*"`` = every peer);
+        pass FaultSpec fields as kwargs, or a prebuilt ``spec=FaultSpec``."""
+        prebuilt = spec.pop("spec", None)
+        self._faults[peer] = prebuilt if prebuilt is not None else FaultSpec(**spec)
+        return self._faults[peer]
+
+    def clear(self, peer: Optional[str] = None) -> None:
+        if peer is None:
+            self._faults.clear()
+        else:
+            self._faults.pop(peer, None)
+
+    def spec_for(self, peer: str) -> Optional[FaultSpec]:
+        return self._faults.get(peer) or self._faults.get("*")
+
+    # ------------------------------------------------------------------
+    async def before_rpc(self, peer: str, method: str) -> None:
+        """Apply ``peer``'s schedule to one outgoing RPC: maybe delay,
+        maybe raise.  A no-op when no schedule matches."""
+        spec = self.spec_for(peer)
+        if spec is None or not spec.matches(method):
+            return
+        if spec.delay > 0:
+            self.injected[(peer, "delay")] += 1
+            await self._sleep(spec.delay)
+        if spec.partition or (
+            spec.error_rate > 0 and self._rng.random() < spec.error_rate
+        ):
+            self.injected[(peer, "error")] += 1
+            raise rpc_error(
+                grpc.StatusCode.UNAVAILABLE,
+                f"injected fault: peer {peer} unavailable",
+            )
+        if spec.drop_rate > 0 and self._rng.random() < spec.drop_rate:
+            self.injected[(peer, "drop")] += 1
+            raise rpc_error(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"injected fault: RPC to peer {peer} dropped",
+            )
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_env(cls, reader) -> Optional["FaultInjector"]:
+        """Build an injector from ``GUBER_FAULT_*`` (config.py EnvReader);
+        None unless ``GUBER_FAULT_PEERS`` names at least one target.
+
+        GUBER_FAULT_PEERS       comma list of peer addresses, or "*"
+        GUBER_FAULT_ERROR_RATE  probability of UNAVAILABLE per RPC
+        GUBER_FAULT_DROP_RATE   probability of DEADLINE_EXCEEDED per RPC
+        GUBER_FAULT_DELAY       added latency (Go-style duration)
+        GUBER_FAULT_PARTITION   bool: 100% UNAVAILABLE
+        GUBER_FAULT_SEED        RNG seed (default 0)
+        """
+        peers = reader.list_("GUBER_FAULT_PEERS")
+        if not peers:
+            return None
+        inj = cls(seed=reader.int_("GUBER_FAULT_SEED", 0))
+        spec = FaultSpec(
+            error_rate=float(reader.str_("GUBER_FAULT_ERROR_RATE", "0") or 0),
+            drop_rate=float(reader.str_("GUBER_FAULT_DROP_RATE", "0") or 0),
+            delay=reader.float_seconds("GUBER_FAULT_DELAY", 0.0),
+            partition=reader.bool_("GUBER_FAULT_PARTITION"),
+        )
+        for p in peers:
+            inj._faults[p] = spec
+        return inj

@@ -16,15 +16,23 @@ The eventually-consistent half of the system (reference ``global.go``):
 Both loops are asyncio tasks on the daemon's event loop; enqueueing is a
 plain dict update (the event loop serializes access, playing the role of
 the reference's channel).
+
+Unlike the reference — which drops a failed flush on the floor — failed
+sends and broadcasts merge back into a bounded redelivery buffer and
+retry each sync window (docs/resilience.md), and both loops run under a
+crash supervisor that restarts them instead of letting reconciliation
+die silently.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Dict, List, Optional
 
 from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.resilience import ResilienceConfig, spawn_supervised
 from gubernator_tpu.utils import tracing
 from gubernator_tpu.types import (
     Behavior,
@@ -34,22 +42,37 @@ from gubernator_tpu.types import (
     set_behavior,
 )
 
+log = logging.getLogger("gubernator.global")
+
 
 class GlobalManager:
     """Owns the two reconciliation loops for one V1Instance."""
 
-    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None):
+    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None,
+                 resilience: Optional[ResilienceConfig] = None):
         self.instance = instance
         self.conf = behaviors
         self.metrics = metrics
+        self.resilience = resilience or ResilienceConfig()
         self._hits: Dict[str, RateLimitRequest] = {}
         self._updates: Dict[str, RateLimitRequest] = {}
         self._hits_kick = asyncio.Event()
         self._updates_kick = asyncio.Event()
         self._running = True
+        # Supervised: a crashed loop logs, counts a restart, and comes
+        # back — a silently dead hits loop would stop reconciliation
+        # forever while requests keep answering from stale local state.
         self._tasks = [
-            asyncio.create_task(self._hits_loop(), name="global-hits"),
-            asyncio.create_task(self._broadcast_loop(), name="global-broadcast"),
+            spawn_supervised(
+                self._hits_loop, name="global-hits",
+                should_restart=lambda: self._running,
+                metrics=metrics, loop_label="global_hits",
+            ),
+            spawn_supervised(
+                self._broadcast_loop, name="global-broadcast",
+                should_restart=lambda: self._running,
+                metrics=metrics, loop_label="global_broadcast",
+            ),
         ]
 
     # ------------------------------------------------------------------
@@ -105,19 +128,26 @@ class GlobalManager:
         while self._running:
             await self._window(self._hits_kick, self._hits)
             hits, self._hits = self._hits, {}
+            # Gauge from actual dict size, not a hardcoded 0: enqueues
+            # racing the swap (and requeues during the flush below) must
+            # stay visible.
             if self.metrics is not None:
-                self.metrics.global_send_queue_length.set(0)
+                self.metrics.global_send_queue_length.set(len(self._hits))
             if hits:
                 await self._send_hits(list(hits.values()))
+                if self.metrics is not None:
+                    self.metrics.global_send_queue_length.set(len(self._hits))
 
     async def _broadcast_loop(self) -> None:
         while self._running:
             await self._window(self._updates_kick, self._updates)
             updates, self._updates = self._updates, {}
             if self.metrics is not None:
-                self.metrics.global_queue_length.set(0)
+                self.metrics.global_queue_length.set(len(self._updates))
             if updates:
                 await self._broadcast(list(updates.values()))
+                if self.metrics is not None:
+                    self.metrics.global_queue_length.set(len(self._updates))
 
     async def _send_hits(self, hits: List[RateLimitRequest]) -> None:
         """Group accumulated hits per owning peer and forward
@@ -157,24 +187,65 @@ class GlobalManager:
             # owner rejects batches over MAX_BATCH_SIZE.
             for i in range(0, len(reqs), limit):
                 async with sem:
+                    chunk = reqs[i : i + limit]
                     try:
-                        await peer.get_peer_rate_limits(reqs[i : i + limit])
+                        await peer.get_peer_rate_limits(chunk)
                     except Exception:
-                        pass  # peer records the error for HealthCheck
+                        # Peer records the error for HealthCheck; the hits
+                        # must not vanish — merge them back into the
+                        # (bounded) redelivery buffer for the next window.
+                        self._requeue_hits(chunk)
 
         async def apply_self(reqs):
             # Same handler an owner applies to relayed batches: forces
             # DRAIN_OVER_LIMIT on GLOBAL hits and queues the broadcast.
             for i in range(0, len(reqs), limit):
+                chunk = reqs[i : i + limit]
                 try:
-                    await self.instance.get_peer_rate_limits(reqs[i : i + limit])
+                    await self.instance.get_peer_rate_limits(chunk)
                 except Exception:
-                    pass
+                    self._requeue_hits(chunk)
 
         await asyncio.gather(
             *(send(p, reqs) for p, reqs in by_owner.values()),
             *((apply_self(local),) if local else ()),
         )
+
+    def _requeue_hits(self, reqs: List[RateLimitRequest]) -> None:
+        """Merge a failed flush chunk back into the hits buffer (the same
+        per-key aggregation queue_hit applies), bounded by the redelivery
+        cap: beyond it records drop and are counted — memory stays
+        bounded even against a peer that never recovers."""
+        limit = self.resilience.redelivery_limit
+        redelivered = dropped = 0
+        for r in reqs:
+            k = r.hash_key()
+            prev = self._hits.get(k)
+            if prev is not None:
+                if has_behavior(r.behavior, Behavior.RESET_REMAINING):
+                    prev.behavior = set_behavior(
+                        prev.behavior, Behavior.RESET_REMAINING, True
+                    )
+                prev.hits += r.hits
+                redelivered += 1
+            elif len(self._hits) < limit:
+                self._hits[k] = r
+                redelivered += 1
+            else:
+                dropped += 1
+        if self.metrics is not None:
+            if redelivered:
+                self.metrics.global_redelivered_hits.inc(redelivered)
+            if dropped:
+                self.metrics.global_dropped_hits.inc(dropped)
+            self.metrics.global_send_queue_length.set(len(self._hits))
+        if dropped:
+            log.warning(
+                "GLOBAL redelivery buffer full (%d keys): dropped %d hit "
+                "records", len(self._hits), dropped,
+            )
+        if redelivered:
+            self._hits_kick.set()  # retry next sync window
 
     async def _broadcast(self, updates: List[RateLimitRequest]) -> None:
         """Re-read current state (hits=0 query) and push it to every other
@@ -211,19 +282,58 @@ class GlobalManager:
             return
         sem = asyncio.Semaphore(self.conf.global_peer_requests_concurrency)
         limit = self.conf.global_batch_limit
+        by_key = {u.hash_key(): u for u in updates}
+        failed_keys: set = set()
 
         async def push(peer):
             for i in range(0, len(globals_), limit):
                 async with sem:
+                    chunk = globals_[i : i + limit]
                     try:
-                        await peer.update_peer_globals(globals_[i : i + limit])
+                        await peer.update_peer_globals(chunk)
                     except Exception:
-                        pass
+                        # Requeue the source updates: the next flush
+                        # re-reads current state and re-pushes to every
+                        # peer (idempotent — authoritative state install).
+                        failed_keys.update(g.key for g in chunk)
 
         peers = [
             p for p in self.instance.get_peer_list() if not p.info.is_owner
         ]
         await asyncio.gather(*(push(p) for p in peers))
+        if failed_keys:
+            self._requeue_updates(
+                [by_key[k] for k in failed_keys if k in by_key]
+            )
+
+    def _requeue_updates(self, reqs: List[RateLimitRequest]) -> None:
+        """Re-enqueue updates whose broadcast failed for some peer, bounded
+        by the redelivery cap.  A key already queued again (newer state
+        pending) needs nothing — the coming broadcast supersedes this one."""
+        limit = self.resilience.redelivery_limit
+        redelivered = dropped = 0
+        for r in reqs:
+            k = r.hash_key()
+            if k in self._updates:
+                continue
+            if len(self._updates) >= limit:
+                dropped += 1
+                continue
+            self._updates[k] = r
+            redelivered += 1
+        if self.metrics is not None:
+            if redelivered:
+                self.metrics.global_redelivered_broadcasts.inc(redelivered)
+            if dropped:
+                self.metrics.global_dropped_broadcasts.inc(dropped)
+            self.metrics.global_queue_length.set(len(self._updates))
+        if dropped:
+            log.warning(
+                "GLOBAL broadcast redelivery buffer full (%d keys): "
+                "dropped %d update records", len(self._updates), dropped,
+            )
+        if redelivered:
+            self._updates_kick.set()
 
     async def close(self) -> None:
         self._running = False

@@ -25,6 +25,11 @@ from typing import Dict, List, Optional, Sequence
 import grpc
 
 from gubernator_tpu.config import BehaviorConfig, Config
+from gubernator_tpu.resilience import (
+    BreakerOpenError,
+    DecorrelatedJitterBackoff,
+    ResilienceConfig,
+)
 from gubernator_tpu.parallel.hashring import (
     HASH_FUNCTIONS,
     RegionPicker,
@@ -83,11 +88,18 @@ class InstanceConfig:
     store: Optional[object] = None
     metrics: Optional[Metrics] = None
     peer_credentials: Optional[grpc.ChannelCredentials] = None
+    # Fault-tolerant peer path (docs/resilience.md): breaker/backoff/
+    # redelivery knobs, plus the optional chaos-test fault injector the
+    # peer clients consult before every RPC.
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    fault_injector: Optional[object] = None
 
     @classmethod
     def from_config(cls, conf: Config, advertise_address: str = "", **kw):
         return cls(
             behaviors=conf.behaviors,
+            resilience=conf.resilience,
+            fault_injector=conf.fault_injector,
             cache_size=conf.cache_size,
             data_center=conf.data_center,
             advertise_address=advertise_address,
@@ -185,7 +197,9 @@ class V1Instance:
         self.region_picker: RegionPicker[PeerClient] = RegionPicker(
             hash_fn, conf.replicas
         )
-        self.global_mgr = GlobalManager(self, conf.behaviors, self.metrics)
+        self.global_mgr = GlobalManager(
+            self, conf.behaviors, self.metrics, resilience=conf.resilience
+        )
         # GLOBAL collectives data plane: use the shared engine if provided,
         # else build one when GUBER_TPU_GLOBAL_MESH_NODES asks for it.
         self.global_mesh = conf.global_mesh
@@ -299,6 +313,11 @@ class V1Instance:
             if peer is None or peer.info.is_owner:
                 local_idx.append(i)
             elif has_behavior(req.behavior, Behavior.GLOBAL):
+                if peer.breaker.is_open():
+                    # Degraded GLOBAL mode: the local answer below is the
+                    # partition-tolerant fallback — count it so operators
+                    # can see how much traffic runs on stale state.
+                    self.metrics.degraded_answers.inc()
                 global_idx.append((i, peer.info.grpc_address))
             else:
                 forward.append((i, peer, req, key))
@@ -503,9 +522,11 @@ class V1Instance:
     async def _async_request(
         self, peer: PeerClient, req: RateLimitRequest, key: str
     ) -> RateLimitResponse:
-        """Forward one item to its owner, ≤5 retries on timeout with fresh
-        owner resolution, self-upgrading if ownership moved here
-        (gubernator.go:311-391).  Span parity: gubernator.go:315
+        """Forward one item to its owner with decorrelated-jitter backoff
+        between attempts (≤ forward_max_attempts retries), fresh owner
+        resolution per retry, self-upgrading if ownership moved here
+        (gubernator.go:311-391), and breaker-aware degraded fallback for
+        GLOBAL keys (docs/resilience.md).  Span parity: gubernator.go:315
         asyncRequest."""
         with tracing.maybe_span(
             "V1Instance.asyncRequest",
@@ -517,10 +538,27 @@ class V1Instance:
     async def _async_request_traced(
         self, peer: PeerClient, req: RateLimitRequest, key: str
     ) -> RateLimitResponse:
+        rconf = self.conf.resilience
+        backoff = DecorrelatedJitterBackoff(
+            rconf.forward_backoff_base, rconf.forward_backoff_cap
+        )
         attempts = 0
         last_err: Optional[Exception] = None
+
+        async def retry(err: Exception) -> None:
+            # Decorrelated-jitter sleep, then re-resolve ownership: the
+            # peer set may have changed while the RPC was failing (the
+            # reference re-resolves too, gubernator.go:311-391 — but with
+            # no backoff, hammering a dead peer in a tight loop).
+            nonlocal attempts, last_err, peer
+            attempts += 1
+            last_err = err
+            self.metrics.batch_send_retries.inc()
+            await asyncio.sleep(backoff.next())
+            peer = self.get_peer(key) or peer
+
         while True:
-            if attempts > 5:
+            if attempts > rconf.forward_max_attempts:
                 self.metrics.check_error_counter.labels(error="Peer not connected").inc()
                 return RateLimitResponse(
                     error=f"GetPeer() keeps returning peers that are not "
@@ -531,16 +569,27 @@ class V1Instance:
                 return resps[0]
             try:
                 resp = await peer.get_peer_rate_limit(req)
+            except BreakerOpenError as e:
+                if has_behavior(req.behavior, Behavior.GLOBAL):
+                    # Degraded mode: the non-owner GLOBAL state is a
+                    # serviceable local answer (DRAIN_OVER_LIMIT semantics
+                    # ride the behavior bits unchanged); hits queue for
+                    # redelivery once the owner recovers.
+                    self.metrics.degraded_answers.inc()
+                    resp = (await self._get_global_rate_limits([req]))[0]
+                    resp.metadata = {
+                        "owner": peer.info.grpc_address, "degraded": "true"
+                    }
+                    return resp
+                await retry(e)
+                continue
             except grpc.aio.AioRpcError as e:
                 if e.code() in (
                     grpc.StatusCode.DEADLINE_EXCEEDED,
                     grpc.StatusCode.CANCELLED,
                     grpc.StatusCode.UNAVAILABLE,
                 ):
-                    attempts += 1
-                    last_err = e
-                    self.metrics.batch_send_retries.inc()
-                    peer = self.get_peer(key) or peer
+                    await retry(e)
                     continue
                 return RateLimitResponse(
                     error=f"Error while fetching rate limit '{key}' from peer: "
@@ -638,7 +687,11 @@ class V1Instance:
     # Health / peers
     # ------------------------------------------------------------------
     def health_check(self) -> HealthCheckResponse:
-        """Aggregate recent per-peer errors (gubernator.go:542-586)."""
+        """Aggregate recent per-peer errors (gubernator.go:542-586), plus
+        the breaker quorum rule: when more than half of the local picker's
+        peers have OPEN circuit breakers this node is partitioned from the
+        cluster majority and reports unhealthy (the daemon's /healthz
+        returns 503 so orchestrators rotate it out)."""
         errs: List[str] = []
         local_peers = self.local_picker.peers()
         for p in local_peers:
@@ -648,6 +701,12 @@ class V1Instance:
         for p in region_peers:
             for msg in p.get_last_err():
                 errs.append(f"error returned from region peer.GetLastErr: {msg}")
+        open_breakers = sum(1 for p in local_peers if p.breaker.is_open())
+        if local_peers and open_breakers * 2 > len(local_peers):
+            errs.append(
+                f"{open_breakers}/{len(local_peers)} local peers have open "
+                f"circuit breakers"
+            )
         return HealthCheckResponse(
             status="unhealthy" if errs else "healthy",
             message="|".join(errs),
@@ -735,6 +794,8 @@ class V1Instance:
             behaviors=self.conf.behaviors,
             channel_credentials=self.conf.peer_credentials,
             metrics=self.metrics,
+            resilience=self.conf.resilience,
+            fault_injector=self.conf.fault_injector,
         )
 
     def get_peer(self, key: str) -> Optional[PeerClient]:

@@ -10,12 +10,19 @@ HealthCheck (``:206-235``), and graceful drain on shutdown (``:408-435``).
 Differences from the reference are idiomatic, not semantic: goroutine +
 channel plumbing becomes one asyncio task per peer; the one-shot interval
 timer becomes ``asyncio.wait_for`` deadlines.
+
+Fault tolerance (docs/resilience.md): every client owns a per-peer
+circuit breaker (open = fail fast with :class:`BreakerOpenError`, no
+dial), consults the optional fault injector before each RPC (chaos
+hook), runs its batch loop under a crash supervisor, and drains — never
+strands — futures enqueued around shutdown.
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections
+import logging
 import time
 from typing import List, Optional, Sequence
 
@@ -25,6 +32,13 @@ import grpc.aio
 from gubernator_tpu.config import BehaviorConfig
 from gubernator_tpu.pb import gubernator_pb2 as pb
 from gubernator_tpu.pb import peers_pb2 as peers_pb
+from gubernator_tpu.resilience import (
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+    ResilienceConfig,
+    spawn_supervised,
+)
 from gubernator_tpu.transport import convert
 from gubernator_tpu.transport.grpc_api import PeersV1Stub
 from gubernator_tpu.types import (
@@ -36,6 +50,8 @@ from gubernator_tpu.types import (
     has_behavior,
 )
 from gubernator_tpu.utils import tracing
+
+log = logging.getLogger("gubernator.peer_client")
 
 
 class ErrorRecorder:
@@ -70,18 +86,54 @@ class PeerClient:
         behaviors: Optional[BehaviorConfig] = None,
         channel_credentials: Optional[grpc.ChannelCredentials] = None,
         metrics=None,
+        resilience: Optional[ResilienceConfig] = None,
+        fault_injector=None,
+        clock=time.monotonic,
     ):
         self._info = info
         self.behaviors = behaviors or BehaviorConfig()
         self.credentials = channel_credentials
         self.metrics = metrics
         self.last_errs = ErrorRecorder()
+        self.resilience = resilience or ResilienceConfig()
+        self.faults = fault_injector
+        rc = self.resilience
+        self.breaker = CircuitBreaker(
+            failure_threshold=rc.breaker_failure_threshold,
+            min_requests=rc.breaker_min_requests,
+            window=rc.breaker_window,
+            open_for=rc.breaker_open_for,
+            open_cap=rc.breaker_open_cap,
+            half_open_probes=rc.breaker_half_open_probes,
+            enabled=rc.breaker_enabled,
+            clock=clock,
+            on_transition=self._on_breaker_transition,
+            name=info.grpc_address,
+        )
+        if self.metrics is not None:
+            self.metrics.breaker_state.labels(
+                peerAddr=info.grpc_address
+            ).set(int(BreakerState.CLOSED))
         self._channel: Optional[grpc.aio.Channel] = None
         self._stub: Optional[PeersV1Stub] = None
         self._queue: Optional[asyncio.Queue] = None
         self._batch_task: Optional[asyncio.Task] = None
         self._inflight: set = set()
         self._closed = False
+
+    def _on_breaker_transition(
+        self, old: BreakerState, new: BreakerState
+    ) -> None:
+        log.info(
+            "peer %s circuit breaker: %s -> %s",
+            self._info.grpc_address, old.name, new.name,
+        )
+        if self.metrics is not None:
+            addr = self._info.grpc_address
+            self.metrics.breaker_state.labels(peerAddr=addr).set(int(new))
+            self.metrics.breaker_transitions.labels(
+                peerAddr=addr, to=new.name.lower()
+            ).inc()
 
     # `info` is attribute-or-callable in pickers; plain attribute here.
     @property
@@ -102,7 +154,16 @@ class PeerClient:
     def _ensure_batch_loop(self) -> asyncio.Queue:
         if self._queue is None:
             self._queue = asyncio.Queue(maxsize=1000)  # peer_client.go:87
-            self._batch_task = asyncio.create_task(self._batch_loop())
+            # Supervised: a crashed batch loop restarts (after failing the
+            # batch it was holding) instead of leaving every subsequent
+            # enqueue hanging forever.
+            self._batch_task = spawn_supervised(
+                self._batch_loop,
+                name=f"peer-batch:{self._info.grpc_address}",
+                should_restart=lambda: not self._closed,
+                metrics=self.metrics,
+                loop_label="peer_batch",
+            )
         return self._queue
 
     # ------------------------------------------------------------------
@@ -116,6 +177,15 @@ class PeerClient:
         traceparent, peer_client.go:140-141/359-360) — injected here, while
         the caller's span is still current, because the batched send happens
         later on the batch-loop task where the ambient context is gone."""
+        if self._closed:
+            raise RuntimeError("peer client is shut down")
+        if self.breaker.is_open():
+            # Fail fast without riding the batch window: the breaker
+            # already knows this peer is down (non-consuming check — the
+            # half-open probe slot belongs to the RPC layer).
+            raise BreakerOpenError(
+                f"circuit breaker open for peer {self._info.grpc_address}"
+            )
         tracing.inject(req.metadata)
         if (
             has_behavior(req.behavior, Behavior.NO_BATCHING)
@@ -123,8 +193,6 @@ class PeerClient:
         ):
             resp = await self.get_peer_rate_limits([req])
             return resp[0]
-        if self._closed:
-            raise RuntimeError("peer client is shut down")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         q = self._ensure_batch_loop()
         if self.metrics is not None:
@@ -138,6 +206,11 @@ class PeerClient:
         self, reqs: Sequence[RateLimitRequest]
     ) -> List[RateLimitResponse]:
         """One unbatched GetPeerRateLimits RPC; responses in request order."""
+        addr = self._info.grpc_address
+        if not self.breaker.allow():
+            msg_ = f"circuit breaker open for peer {addr}"
+            self.last_errs.record(msg_)
+            raise BreakerOpenError(msg_)
         stub = self._ensure_channel()
         msg = peers_pb.GetPeerRateLimitsReq(
             requests=[convert.req_to_pb(r) for r in reqs]
@@ -147,17 +220,21 @@ class PeerClient:
         hdrs: dict = {}
         tracing.inject(hdrs)
         try:
+            if self.faults is not None:
+                await self.faults.before_rpc(addr, "GetPeerRateLimits")
             out = await stub.GetPeerRateLimits(
                 msg,
                 timeout=self.behaviors.batch_timeout,
                 metadata=tuple(hdrs.items()) or None,
             )
         except grpc.aio.AioRpcError as e:
+            self.breaker.record_failure()
             self.last_errs.record(
                 f"while fetching rate limits from peer "
-                f"{self._info.grpc_address}: {e.details()}"
+                f"{addr}: {e.details()}"
             )
             raise
+        self.breaker.record_success()
         if len(out.rate_limits) != len(reqs):
             raise RuntimeError(
                 "server responded with incorrect rate limit list size"
@@ -166,6 +243,11 @@ class PeerClient:
 
     async def update_peer_globals(self, updates: Sequence[GlobalUpdate]) -> None:
         """Push authoritative GLOBAL state to this peer."""
+        addr = self._info.grpc_address
+        if not self.breaker.allow():
+            msg_ = f"circuit breaker open for peer {addr}"
+            self.last_errs.record(msg_)
+            raise BreakerOpenError(msg_)
         stub = self._ensure_channel()
         msg = peers_pb.UpdatePeerGlobalsReq()
         for u in updates:
@@ -176,13 +258,17 @@ class PeerClient:
             g.created_at = u.created_at
             g.status.CopyFrom(convert.resp_to_pb(u.status))
         try:
+            if self.faults is not None:
+                await self.faults.before_rpc(addr, "UpdatePeerGlobals")
             await stub.UpdatePeerGlobals(msg, timeout=self.behaviors.global_timeout)
         except grpc.aio.AioRpcError as e:
+            self.breaker.record_failure()
             self.last_errs.record(
-                f"while updating peer globals on {self._info.grpc_address}: "
+                f"while updating peer globals on {addr}: "
                 f"{e.details()}"
             )
             raise
+        self.breaker.record_success()
 
     def get_last_err(self) -> List[str]:
         return self.last_errs.errors()
@@ -195,25 +281,50 @@ class PeerClient:
         while True:
             item = await self._queue.get()
             if item is None:
+                # Shutdown sentinel: anything enqueued after it raced the
+                # close — fail those waiters instead of stranding them.
+                self._fail_queued("peer client is shut down")
                 return
             batch = [item]
-            deadline = loop.time() + self.behaviors.batch_wait
-            while len(batch) < self.behaviors.batch_limit:
-                timeout = deadline - loop.time()
-                if timeout <= 0:
-                    break
-                try:
-                    nxt = await asyncio.wait_for(self._queue.get(), timeout)
-                except asyncio.TimeoutError:
-                    break
-                if nxt is None:
-                    await self._send_batch(batch)
-                    return
-                batch.append(nxt)
+            try:
+                deadline = loop.time() + self.behaviors.batch_wait
+                while len(batch) < self.behaviors.batch_limit:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        nxt = await asyncio.wait_for(self._queue.get(), timeout)
+                    except asyncio.TimeoutError:
+                        break
+                    if nxt is None:
+                        await self._send_batch(batch)
+                        self._fail_queued("peer client is shut down")
+                        return
+                    batch.append(nxt)
+            except Exception as e:
+                # Window assembly crashed: fail this batch's waiters and
+                # keep serving — never die holding futures.
+                log.exception(
+                    "peer %s batch window crashed", self._info.grpc_address
+                )
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
             # Send concurrently so the window keeps filling during the RPC.
             t = asyncio.create_task(self._send_batch(batch))
             self._inflight.add(t)
             t.add_done_callback(self._inflight.discard)
+
+    def _fail_queued(self, msg: str) -> None:
+        """Drain the batch queue, failing every waiter with ``msg``."""
+        while self._queue is not None and not self._queue.empty():
+            item = self._queue.get_nowait()
+            if item is None:
+                continue
+            _, fut = item
+            if not fut.done():
+                fut.set_exception(RuntimeError(msg))
 
     async def _send_batch(self, batch: List[tuple]) -> None:
         """One RPC for the whole window; distribute ordered responses, or
@@ -259,5 +370,8 @@ class PeerClient:
                 self._batch_task.cancel()
         if self._inflight:
             await asyncio.gather(*list(self._inflight), return_exceptions=True)
+        # Stragglers that enqueued between the sentinel drain and the batch
+        # task exiting (or after a cancel) must not hang forever.
+        self._fail_queued("peer client is shut down")
         if self._channel is not None:
             await self._channel.close()

@@ -354,6 +354,7 @@ class Daemon:
         app = web.Application(client_max_size=MAX_RECV_BYTES)
         app.router.add_post("/v1/GetRateLimits", self._h_get_rate_limits)
         app.router.add_get("/v1/HealthCheck", self._h_health_check)
+        app.router.add_get("/healthz", self._h_health_check)
         if include_metrics:
             app.router.add_get("/metrics", self._h_metrics)
         return app
@@ -380,6 +381,7 @@ class Daemon:
         if self.conf.http_status_listen_address:
             sapp = web.Application()
             sapp.router.add_get("/v1/HealthCheck", self._h_health_check)
+            sapp.router.add_get("/healthz", self._h_health_check)
             sapp.router.add_get("/metrics", self._h_metrics)
             srunner = web.AppRunner(sapp, access_log=None)
             await srunner.setup()
@@ -428,7 +430,12 @@ class Daemon:
         # Tier occupancy rides the health JSON as extra keys (the proto
         # message is unchanged — wire-compatible clients ignore them).
         body["occupancy"] = self.instance.occupancy()
-        return web.json_response(body)
+        # Unhealthy (e.g. a majority of peers behind open circuit
+        # breakers) maps to 503 so plain HTTP probes — k8s liveness,
+        # LB health checks — rotate the node without parsing JSON.
+        return web.json_response(
+            body, status=200 if h.status == "healthy" else 503
+        )
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
         eng = self.instance.engine

@@ -243,6 +243,62 @@ class Metrics:
             registry=reg,
         )
 
+        # Fault-tolerant peer path (docs/resilience.md): per-peer breaker
+        # state, redelivery accounting for GLOBAL hits/broadcasts that
+        # failed to flush, degraded GLOBAL answers served while the
+        # owner's breaker was open, and background-loop crash restarts.
+        self.breaker_state = Gauge(
+            "gubernator_breaker_state",
+            "Circuit breaker state per peer: 0=closed, 1=half-open, 2=open.",
+            ["peerAddr"],
+            registry=reg,
+        )
+        self.breaker_transitions = Counter(
+            "gubernator_breaker_transitions",
+            "Circuit breaker state transitions per peer; label \"to\" is "
+            "the state entered (closed/half_open/open).",
+            ["peerAddr", "to"],
+            registry=reg,
+        )
+        self.degraded_answers = Counter(
+            "gubernator_degraded_answers",
+            "GLOBAL requests answered from local non-owner state while "
+            "the owning peer's circuit breaker was open (degraded mode).",
+            registry=reg,
+        )
+        self.global_redelivered_hits = Counter(
+            "gubernator_global_redelivered_hits",
+            "GLOBAL hit records re-enqueued into the redelivery buffer "
+            "after a failed flush to the owning peer.",
+            registry=reg,
+        )
+        self.global_dropped_hits = Counter(
+            "gubernator_global_dropped_hits",
+            "GLOBAL hit records dropped because the redelivery buffer "
+            "was at its cap (GUBER_REDELIVERY_LIMIT) — lost accounting.",
+            registry=reg,
+        )
+        self.global_redelivered_broadcasts = Counter(
+            "gubernator_global_redelivered_broadcasts",
+            "GLOBAL update records re-enqueued for broadcast after a "
+            "failed push to one or more peers.",
+            registry=reg,
+        )
+        self.global_dropped_broadcasts = Counter(
+            "gubernator_global_dropped_broadcasts",
+            "GLOBAL update records dropped because the broadcast "
+            "redelivery buffer was at its cap.",
+            registry=reg,
+        )
+        self.loop_restarts = Counter(
+            "gubernator_loop_restarts",
+            "Background loops (global_hits, global_broadcast, peer_batch) "
+            "restarted by their crash supervisor after an unexpected "
+            "exception.",
+            ["loop"],
+            registry=reg,
+        )
+
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
         (reference flags.go:20-23 + daemon.go:276-287).  "os" → process

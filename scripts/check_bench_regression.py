@@ -35,12 +35,21 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   demote_readbacks_per_reclaim   1.0 — the demote readback runs only in
 #                                        reclaim rounds with LRU victims;
 #                                        reclaim-free ticks never pay it
+#   hit_redelivery_loss            0   — the chaos rung's partitioned-owner
+#                                        GLOBAL hits all land after recovery
+#                                        (docs/resilience.md redelivery)
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
     "promote_dispatches_per_hit_tick",
     "demote_readbacks_per_reclaim",
+    "hit_redelivery_loss",
 )
+
+# Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
+# the rung: each is an absolute correctness invariant, not a relative
+# performance figure.
+ABSOLUTE_ZERO_KEYS = ("churn_continuity_errors", "hit_redelivery_loss")
 
 
 def load_bench(path):
@@ -221,9 +230,11 @@ def main():
             failed = True
         print(f"  {name}: {b:g} -> {c:g} (count, lower is better, {mark})")
     for key in sorted(set(base_counts) ^ set(cand_counts)):
-        if key in cand_counts and key[1] == "churn_continuity_errors":
-            # Absolute invariant — a re-promoted key losing its consumed
-            # budget is a rate-limit bypass, baseline rung or not.
+        if key in cand_counts and key[1] in ABSOLUTE_ZERO_KEYS:
+            # Absolute invariants — a re-promoted key losing its consumed
+            # budget is a rate-limit bypass, and a GLOBAL hit that never
+            # lands after peer recovery is lost accounting; baseline rung
+            # or not, the candidate must report exactly 0.
             gated += 1
             v = cand_counts[key]
             mark = "FAIL" if v > 0 else "ok"

@@ -1,0 +1,300 @@
+"""Chaos suite: fault-injected clusters proving the peer path degrades
+instead of lying (docs/resilience.md acceptance runs).
+
+Scenarios: one peer at 100% injected RPC failure (breaker opens, GLOBAL
+still answers locally, hits redeliver with zero loss on recovery), a peer
+killed mid-flush and restarted, and degraded-mode limit enforcement
+(DRAIN_OVER_LIMIT preserved).  All runs are seeded, use sub-100ms
+breaker/sync windows, and end by asserting no background loop died —
+metrics are the oracle (functional_test.go:2184-2276 pattern), never bare
+sleeps.
+"""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.cluster import Cluster
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.resilience import FaultInjector, ResilienceConfig
+from gubernator_tpu.types import Behavior, RateLimitRequest, Status
+
+
+def req(name, key, hits=1, limit=1_000_000, duration=3_600_000, **kw):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=duration, behavior=Behavior.GLOBAL, **kw
+    )
+
+
+def fast_chaos_conf():
+    behaviors = BehaviorConfig(global_sync_wait=0.02, batch_wait=0.001)
+    resilience = ResilienceConfig(
+        breaker_open_for=0.05,
+        breaker_open_cap=0.1,
+        breaker_min_requests=3,
+        forward_backoff_base=0.002,
+        forward_backoff_cap=0.02,
+    )
+    return behaviors, resilience
+
+
+def assert_no_loop_dead(cluster):
+    """Acceptance (c): after the run, every background loop — GLOBAL hits,
+    broadcast, and each peer's batch loop — is still alive."""
+    for d in cluster.daemons:
+        for t in d.instance.global_mgr._tasks:
+            assert not t.done(), f"dead loop {t.get_name()} on {d.advertise_address}"
+        for p in d.instance.get_peer_list():
+            if p._batch_task is not None:
+                assert not p._batch_task.done(), (
+                    f"dead batch loop for {p.info.grpc_address}"
+                )
+
+
+async def poll_consumed(daemon, name, key, want, limit=1_000_000,
+                        timeout=10.0):
+    """Poll a daemon's local GLOBAL state until ``want`` hits landed."""
+    client = daemon.client()
+
+    async def poll():
+        while True:
+            r = (await client.get_rate_limits(
+                [req(name, key, hits=0, limit=limit)]
+            ))[0]
+            if limit - r.remaining == want:
+                return r
+            await asyncio.sleep(0.02)
+
+    try:
+        return await asyncio.wait_for(poll(), timeout=timeout)
+    finally:
+        await client.close()
+
+
+async def test_chaos_100pct_failure_degrades_then_redelivers():
+    """The ISSUE's acceptance run: one peer at 100% injected RPC failure.
+    (a) the breaker opens within the configured threshold and GLOBAL
+    requests still answer locally; (b) zero hits are lost once the peer
+    recovers; (c) no background loop is dead at the end."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=7)
+    c = await Cluster.start(3, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaos", "ck"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        ni = c.daemons.index(non_owner)
+        owner_addr = owner.conf.grpc_listen_address
+        inj.set_fault(owner_addr, partition=True)
+
+        client = non_owner.client()
+        sent = 0
+        for _ in range(30):
+            out = await client.get_rate_limits([req(name, key)])
+            # (a) degraded mode: local answers, never errors.
+            assert out[0].error == ""
+            assert out[0].status == Status.UNDER_LIMIT
+            sent += 1
+            await asyncio.sleep(0.005)
+        await client.close()
+
+        # (a) the breaker opened (metrics oracle, not sleeps) and flushes
+        # were re-enqueued instead of dropped.
+        await c.wait_for_metric(
+            ni, "gubernator_breaker_transitions_total",
+            labels={"peerAddr": owner_addr, "to": "open"},
+        )
+        await c.wait_for_metric(ni, "gubernator_global_redelivered_hits_total")
+        assert c.metric_value(ni, "gubernator_degraded_answers_total") >= 1
+        assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+
+        # Recovery: (b) every hit lands on the owner — zero loss.
+        inj.clear()
+        await poll_consumed(owner, name, key, sent)
+        assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+        # The breaker closed again after a successful probe.
+        await c.wait_for_metric(
+            ni, "gubernator_breaker_transitions_total",
+            labels={"peerAddr": owner_addr, "to": "closed"},
+        )
+        # (c) nothing died.
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
+async def test_chaos_drain_over_limit_preserved_in_degraded_mode():
+    """Degraded GLOBAL answers still enforce the limit locally, and the
+    redelivered hits drain the owner's bucket (DRAIN_OVER_LIMIT is forced
+    on the owner's relay path) instead of erroring or going negative."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=11)
+    c = await Cluster.start(3, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaos-drain", "dk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        inj.set_fault(owner.conf.grpc_listen_address, partition=True)
+
+        client = non_owner.client()
+        statuses = []
+        for _ in range(7):
+            out = await client.get_rate_limits(
+                [req(name, key, hits=1, limit=5, duration=300_000)]
+            )
+            assert out[0].error == ""
+            statuses.append(out[0].status)
+            await asyncio.sleep(0.005)
+        await client.close()
+        # Local degraded enforcement: 5 under, then over — the partition
+        # never turns the limiter into an allow-all.
+        assert statuses[:5] == [Status.UNDER_LIMIT] * 5
+        assert statuses[5:] == [Status.OVER_LIMIT] * 2
+
+        inj.clear()
+        # All 7 queued hits redeliver; DRAIN_OVER_LIMIT on the owner's
+        # relay path pins the bucket at 0 rather than erroring/negative.
+        oc = owner.client()
+
+        async def owner_drained():
+            while True:
+                r = (await oc.get_rate_limits(
+                    [req(name, key, hits=0, limit=5, duration=300_000)]
+                ))[0]
+                if r.remaining == 0:
+                    return r
+                await asyncio.sleep(0.02)
+
+        await asyncio.wait_for(owner_drained(), timeout=10)
+        # One more hit against the drained bucket is OVER_LIMIT (a zero-hit
+        # query reports UNDER — nothing was requested).
+        oc2 = owner.client()
+        r = (await oc2.get_rate_limits(
+            [req(name, key, hits=1, limit=5, duration=300_000)]
+        ))[0]
+        await oc2.close()
+        assert r.status == Status.OVER_LIMIT
+        assert r.remaining == 0
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
+async def test_chaos_kill_peer_mid_flush_redelivers_after_restart():
+    """A peer that actually dies (daemon closed, not injected) mid-flush:
+    hits buffer locally and land once the peer comes back on the same
+    address."""
+    behaviors, resilience = fast_chaos_conf()
+    c = await Cluster.start(2, behaviors=behaviors, resilience=resilience)
+    try:
+        name, key = "chaos-kill", "kk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        owner_idx = c.daemons.index(owner)
+        ni = c.daemons.index(non_owner)
+
+        # Kill the owner BEFORE any flush can land, then drive traffic:
+        # every flush of these hits happens against a dead peer.
+        await owner.close()
+        client = non_owner.client()
+        sent = 0
+        for _ in range(20):
+            out = await client.get_rate_limits([req(name, key)])
+            assert out[0].error == ""
+            sent += 1
+            await asyncio.sleep(0.005)
+        await client.close()
+        await c.wait_for_metric(
+            ni, "gubernator_global_redelivered_hits_total", timeout=10,
+        )
+
+        # Resurrect the owner on its old port; redelivery drains into it.
+        owner = await c.restart(owner_idx)
+        await poll_consumed(owner, name, key, sent, timeout=15)
+        assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
+async def test_chaos_intermittent_errors_recover_without_loss():
+    """50% injected error rate (seeded): slower, flappier — but the
+    accounting still converges to zero loss and the loops survive."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=23)
+    c = await Cluster.start(2, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaos-flap", "fk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        inj.set_fault(owner.conf.grpc_listen_address, error_rate=0.5)
+
+        client = non_owner.client()
+        sent = 0
+        for _ in range(25):
+            out = await client.get_rate_limits([req(name, key)])
+            assert out[0].error == ""
+            sent += 1
+            await asyncio.sleep(0.004)
+        await client.close()
+
+        inj.clear()
+        await poll_consumed(owner, name, key, sent)
+        ni = c.daemons.index(non_owner)
+        assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
+async def test_chaos_forward_path_faults_surface_as_retries():
+    """Non-GLOBAL forwards against an injected-faulty owner: drops
+    (DEADLINE_EXCEEDED) retry with backoff and eventually exhaust into the
+    reference's 'not connected' error — the caller always gets an answer,
+    never a hang."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=5)
+    c = await Cluster.start(2, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaos-fwd", "wk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        inj.set_fault(owner.conf.grpc_listen_address, drop_rate=1.0)
+
+        out = await asyncio.wait_for(
+            non_owner.instance.get_rate_limits(
+                [RateLimitRequest(name=name, unique_key=key, hits=1,
+                                  limit=10, duration=60_000)]
+            ),
+            timeout=10,
+        )
+        assert "not connected" in out[0].error
+        ni = c.daemons.index(non_owner)
+        assert c.metric_value(
+            ni, "gubernator_batch_send_retries_total"
+        ) >= resilience.forward_max_attempts
+
+        # Clear the fault: the next forward works again (breaker probes
+        # through half-open within its 50ms open window).
+        inj.clear()
+
+        async def forward_recovers():
+            while True:
+                out = await non_owner.instance.get_rate_limits(
+                    [RateLimitRequest(name=name, unique_key=key, hits=1,
+                                      limit=10, duration=60_000)]
+                )
+                if out[0].error == "":
+                    return out[0]
+                await asyncio.sleep(0.05)
+
+        r = await asyncio.wait_for(forward_recovers(), timeout=10)
+        assert r.status == Status.UNDER_LIMIT
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()

@@ -158,3 +158,58 @@ def test_global_mesh_capacity_guard(caplog):
 
     with pytest.raises(ValueError, match="GLOBAL_MESH_CAPACITY"):
         MeshGlobalEngine(capacity=GLOBAL_MESH_CAPACITY_HARD * 2)
+
+
+def test_resilience_env_surface():
+    """GUBER_BREAKER_* / GUBER_FORWARD_* / GUBER_REDELIVERY_LIMIT flow into
+    ResilienceConfig (docs/resilience.md)."""
+    c = conf_from({
+        "GUBER_BREAKER_FAILURE_THRESHOLD": "0.25",
+        "GUBER_BREAKER_MIN_REQUESTS": "9",
+        "GUBER_BREAKER_WINDOW": "5s",
+        "GUBER_BREAKER_OPEN_FOR": "250ms",
+        "GUBER_BREAKER_OPEN_CAP": "10s",
+        "GUBER_FORWARD_MAX_ATTEMPTS": "2",
+        "GUBER_FORWARD_BACKOFF_BASE": "1ms",
+        "GUBER_REDELIVERY_LIMIT": "123",
+    })
+    r = c.config.resilience
+    assert r.breaker_failure_threshold == pytest.approx(0.25)
+    assert r.breaker_min_requests == 9
+    assert r.breaker_window == pytest.approx(5.0)
+    assert r.breaker_open_for == pytest.approx(0.25)
+    assert r.breaker_open_cap == pytest.approx(10.0)
+    assert r.forward_max_attempts == 2
+    assert r.forward_backoff_base == pytest.approx(0.001)
+    assert r.redelivery_limit == 123
+    # Defaults: breaker on, no injector.
+    assert r.breaker_enabled
+    assert c.config.fault_injector is None
+
+
+def test_resilience_env_validation():
+    with pytest.raises(ValueError, match="GUBER_BREAKER_FAILURE_THRESHOLD"):
+        conf_from({"GUBER_BREAKER_FAILURE_THRESHOLD": "1.5"})
+    with pytest.raises(ValueError, match="GUBER_REDELIVERY_LIMIT"):
+        conf_from({"GUBER_REDELIVERY_LIMIT": "-1"})
+    with pytest.raises(ValueError, match="GUBER_FORWARD_MAX_ATTEMPTS"):
+        conf_from({"GUBER_FORWARD_MAX_ATTEMPTS": "-2"})
+
+
+def test_fault_injector_env_surface():
+    """GUBER_FAULT_* builds a seeded injector at daemon setup (the chaos
+    config hook for staging game-days)."""
+    c = conf_from({
+        "GUBER_FAULT_PEERS": "10.0.0.1:81,10.0.0.2:81",
+        "GUBER_FAULT_ERROR_RATE": "0.5",
+        "GUBER_FAULT_DELAY": "5ms",
+        "GUBER_FAULT_SEED": "42",
+    })
+    inj = c.config.fault_injector
+    assert inj is not None
+    spec = inj.spec_for("10.0.0.1:81")
+    assert spec is not None and spec.error_rate == pytest.approx(0.5)
+    assert spec.delay == pytest.approx(0.005)
+    assert inj.spec_for("10.0.0.3:81") is None
+    # Unset → no injector in the hot path.
+    assert conf_from({}).config.fault_injector is None

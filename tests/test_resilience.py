@@ -1,0 +1,643 @@
+"""Unit tests for the fault-tolerant peer path (docs/resilience.md):
+circuit breaker transitions, decorrelated-jitter backoff, the fault
+injector, the crash supervisor, PeerClient shutdown drain, GLOBAL queue
+gauges / bounded redelivery, the breaker-quorum health rule, and the
+forward path's ownership re-resolution.
+
+Everything here runs on virtual time (ManualClock) or sub-second asyncio
+windows — no real sleeps longer than the supervisor's 10 ms restart delay.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.resilience import (
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+    DecorrelatedJitterBackoff,
+    FaultInjector,
+    ManualClock,
+    ResilienceConfig,
+    spawn_supervised,
+)
+from gubernator_tpu.resilience.faults import rpc_error
+from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+from gubernator_tpu.service.peer_client import PeerClient
+from gubernator_tpu.types import (
+    Behavior,
+    PeerInfo,
+    RateLimitRequest,
+    RateLimitResponse,
+)
+from gubernator_tpu.utils.metrics import Metrics
+
+
+def req(name="res", key="k", hits=1, limit=10, duration=60_000, **kw):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=duration, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backoff
+# ---------------------------------------------------------------------------
+def test_backoff_bounds_and_determinism():
+    import random
+
+    b1 = DecorrelatedJitterBackoff(0.01, 0.5, rng=random.Random(42))
+    b2 = DecorrelatedJitterBackoff(0.01, 0.5, rng=random.Random(42))
+    seq1 = [b1.next() for _ in range(20)]
+    seq2 = [b2.next() for _ in range(20)]
+    assert seq1 == seq2  # seeded → replayable
+    assert all(0.01 <= d <= 0.5 for d in seq1)
+    # The walk grows well past the base (expected growth ~2x per step,
+    # though jitter can shrink it on any single draw).
+    assert max(seq1) > 0.05
+    b1.reset()
+    assert b1.next() <= 0.03  # back near base: uniform(base, base*3)
+
+
+def test_backoff_rejects_bad_params():
+    with pytest.raises(ValueError):
+        DecorrelatedJitterBackoff(0, 1.0)
+    with pytest.raises(ValueError):
+        DecorrelatedJitterBackoff(1.0, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (virtual clock; no sleeps)
+# ---------------------------------------------------------------------------
+def make_breaker(clk, **kw):
+    kw.setdefault("min_requests", 4)
+    kw.setdefault("failure_threshold", 0.5)
+    kw.setdefault("window", 10.0)
+    kw.setdefault("open_for", 1.0)
+    kw.setdefault("open_cap", 4.0)
+    return CircuitBreaker(clock=clk, **kw)
+
+
+def test_breaker_opens_on_failure_rate():
+    clk = ManualClock()
+    transitions = []
+    b = make_breaker(clk, on_transition=lambda o, n: transitions.append((o, n)))
+    # Below the volume floor: 3 failures don't trip.
+    for _ in range(3):
+        b.record_failure()
+    assert b.state is BreakerState.CLOSED
+    b.record_failure()  # 4th: rate 100% >= 50% and volume floor met
+    assert b.state is BreakerState.OPEN
+    assert not b.allow()
+    assert b.is_open()
+    assert transitions == [(BreakerState.CLOSED, BreakerState.OPEN)]
+
+
+def test_breaker_mixed_window_respects_threshold():
+    clk = ManualClock()
+    b = make_breaker(clk, min_requests=4, failure_threshold=0.5)
+    # 3 successes, 2 failures → rate 0.4 < 0.5: stays closed.
+    for _ in range(3):
+        b.record_success()
+    for _ in range(2):
+        b.record_failure()
+    assert b.state is BreakerState.CLOSED
+    b.record_failure()  # 3/6 = 0.5: trips
+    assert b.state is BreakerState.OPEN
+
+
+def test_breaker_half_open_probe_success_closes():
+    clk = ManualClock()
+    b = make_breaker(clk)
+    for _ in range(4):
+        b.record_failure()
+    assert not b.allow()
+    clk.advance(5.0)  # past any open duration (cap 4.0)
+    assert b.state is BreakerState.HALF_OPEN
+    assert b.allow()       # the single probe slot
+    assert not b.allow()   # concurrent requests still fail fast
+    b.record_success()
+    assert b.state is BreakerState.CLOSED
+    assert b.allow()
+
+
+def test_breaker_probe_failure_reopens_with_backoff():
+    import random
+
+    clk = ManualClock()
+    b = make_breaker(clk, rng=random.Random(7))
+    for _ in range(4):
+        b.record_failure()
+    first_open = b._open_until - clk.now()
+    clk.advance(5.0)
+    assert b.allow()       # probe
+    b.record_failure()     # probe fails
+    assert b.state is BreakerState.OPEN
+    second_open = b._open_until - clk.now()
+    # Decorrelated jitter: the draw range starts at base both times, but
+    # the open duration stays within [base, cap] and the breaker is OPEN
+    # again without needing another volume window.
+    assert 1.0 <= second_open <= 4.0
+    assert 1.0 <= first_open <= 4.0
+
+
+def test_breaker_window_ages_out_failures():
+    clk = ManualClock()
+    b = make_breaker(clk, window=10.0)
+    for _ in range(3):
+        b.record_failure()
+    clk.advance(11.0)  # the old failures fall out of the window
+    b.record_failure()
+    # Only 1 sample in-window: under the volume floor, stays closed.
+    assert b.state is BreakerState.CLOSED
+
+
+def test_breaker_disabled_never_trips():
+    clk = ManualClock()
+    b = make_breaker(clk, enabled=False)
+    for _ in range(50):
+        b.record_failure()
+    assert b.allow()
+    assert not b.is_open()
+
+
+def test_breaker_force_open():
+    clk = ManualClock()
+    b = make_breaker(clk)
+    b.force_open(60.0)
+    assert b.is_open()
+    clk.advance(30.0)
+    assert b.is_open()
+    clk.advance(31.0)
+    assert b.state is BreakerState.HALF_OPEN
+
+
+# ---------------------------------------------------------------------------
+# Fault injector
+# ---------------------------------------------------------------------------
+async def test_fault_injector_partition_error_drop():
+    inj = FaultInjector(seed=3)
+    inj.set_fault("p1", partition=True)
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await inj.before_rpc("p1", "GetPeerRateLimits")
+    assert e.value.code() == grpc.StatusCode.UNAVAILABLE
+    # Other peers unaffected.
+    await inj.before_rpc("p2", "GetPeerRateLimits")
+
+    inj.set_fault("p2", drop_rate=1.0)
+    with pytest.raises(grpc.aio.AioRpcError) as e:
+        await inj.before_rpc("p2", "UpdatePeerGlobals")
+    assert e.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert inj.injected[("p1", "error")] == 1
+    assert inj.injected[("p2", "drop")] == 1
+
+    inj.clear("p1")
+    await inj.before_rpc("p1", "GetPeerRateLimits")  # schedule removed
+
+
+async def test_fault_injector_seeded_error_rate_replays():
+    async def draw(seed):
+        inj = FaultInjector(seed=seed)
+        inj.set_fault("*", error_rate=0.5)
+        outcomes = []
+        for _ in range(32):
+            try:
+                await inj.before_rpc("px", "GetPeerRateLimits")
+                outcomes.append(0)
+            except grpc.aio.AioRpcError:
+                outcomes.append(1)
+        return outcomes
+
+    a = await draw(11)
+    b = await draw(11)
+    c = await draw(12)
+    assert a == b          # same seed → same schedule
+    assert 0 < sum(a) < 32  # actually probabilistic
+    assert a != c
+
+
+async def test_fault_injector_delay_uses_virtual_clock():
+    clk = ManualClock()
+    inj = FaultInjector(seed=0, clock=clk, sleep=clk.sleep)
+    inj.set_fault("p1", delay=0.25)
+    await inj.before_rpc("p1", "GetPeerRateLimits")
+    assert clk.sleeps == [0.25]  # no real wall-clock sleep happened
+    assert clk.now() == 0.25
+
+
+async def test_fault_injector_method_filter():
+    inj = FaultInjector()
+    inj.set_fault("p1", partition=True, methods=("UpdatePeerGlobals",))
+    await inj.before_rpc("p1", "GetPeerRateLimits")  # not matched
+    with pytest.raises(grpc.aio.AioRpcError):
+        await inj.before_rpc("p1", "UpdatePeerGlobals")
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+async def test_supervisor_restarts_crashed_loop():
+    metrics = Metrics()
+    ran = []
+    done = asyncio.Event()
+
+    async def loop_body():
+        ran.append(1)
+        if len(ran) < 3:
+            raise RuntimeError("boom")
+        done.set()
+
+    t = spawn_supervised(
+        loop_body, name="t", metrics=metrics, loop_label="test_loop",
+        restart_delay=0.001,
+    )
+    await asyncio.wait_for(done.wait(), 2)
+    await t
+    assert len(ran) == 3
+    assert metrics.sample(
+        "gubernator_loop_restarts_total", {"loop": "test_loop"}
+    ) == 2
+
+
+async def test_supervisor_stops_when_owner_closed():
+    stop = []
+
+    async def loop_body():
+        raise RuntimeError("boom")
+
+    t = spawn_supervised(
+        loop_body, name="t", should_restart=lambda: not stop,
+        restart_delay=0.001,
+    )
+    stop.append(1)
+    await asyncio.wait_for(t, 2)  # returns instead of restarting forever
+
+
+# ---------------------------------------------------------------------------
+# PeerClient shutdown drain (satellite: no hung futures)
+# ---------------------------------------------------------------------------
+async def test_peer_client_drains_requests_enqueued_after_sentinel():
+    client = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"))
+    q = client._ensure_batch_loop()
+    fut = asyncio.get_running_loop().create_future()
+    # Interleaving under test: the shutdown sentinel lands first, a
+    # straggler request right after — before the batch loop task runs.
+    q.put_nowait(None)
+    q.put_nowait((req(), fut))
+    with pytest.raises(RuntimeError, match="shut down"):
+        await asyncio.wait_for(fut, 2)
+    await client.shutdown()
+
+
+async def test_peer_client_rejects_after_closed():
+    client = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"))
+    await client.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        await client.get_peer_rate_limit(req())
+
+
+async def test_peer_client_breaker_open_fails_fast_without_dial():
+    client = PeerClient(PeerInfo(grpc_address="127.0.0.1:1"))
+    client.breaker.force_open(60.0)
+    with pytest.raises(BreakerOpenError):
+        await client.get_peer_rate_limit(req())
+    with pytest.raises(BreakerOpenError):
+        await client.get_peer_rate_limits([req()])
+    with pytest.raises(BreakerOpenError):
+        await client.update_peer_globals([])
+    assert client._channel is None  # fail fast means no dial at all
+    assert any("circuit breaker open" in m for m in client.get_last_err())
+    await client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# GlobalManager: gauges + bounded redelivery (satellites)
+# ---------------------------------------------------------------------------
+class FailingPeer:
+    """Peer stub whose RPCs always fail UNAVAILABLE."""
+
+    def __init__(self, addr="10.0.0.9:81"):
+        self.info = PeerInfo(grpc_address=addr)
+        self.calls = 0
+        self.breaker = CircuitBreaker(name=addr)
+
+    async def get_peer_rate_limits(self, reqs):
+        self.calls += 1
+        raise rpc_error(grpc.StatusCode.UNAVAILABLE, "down")
+
+    async def update_peer_globals(self, updates):
+        self.calls += 1
+        raise rpc_error(grpc.StatusCode.UNAVAILABLE, "down")
+
+
+class FakeInstance:
+    """Just enough V1Instance surface for a GlobalManager."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def get_peer(self, key):
+        return self.peer
+
+    def get_peer_list(self):
+        return [self.peer]
+
+    async def get_peer_rate_limits(self, reqs):
+        return [RateLimitResponse() for _ in reqs]
+
+    async def apply_local(self, reqs):
+        return [RateLimitResponse(limit=r.limit, remaining=r.limit)
+                for r in reqs]
+
+
+async def test_global_send_queue_gauge_tracks_requeued_hits():
+    metrics = Metrics()
+    peer = FailingPeer()
+    mgr = GlobalManager(
+        FakeInstance(peer),
+        BehaviorConfig(global_sync_wait=0.01),
+        metrics,
+        resilience=ResilienceConfig(redelivery_limit=100),
+    )
+    try:
+        for i in range(3):
+            mgr.queue_hit(req(key=f"g-{i}", behavior=Behavior.GLOBAL))
+        # Wait until at least one flush failed and requeued.
+        for _ in range(200):
+            if metrics.sample("gubernator_global_redelivered_hits_total") > 0:
+                break
+            await asyncio.sleep(0.01)
+        assert peer.calls >= 1
+        # The gauge must reflect the requeued keys, not a hardcoded 0.
+        assert metrics.sample("gubernator_global_send_queue_length") == \
+            len(mgr._hits) > 0
+        assert metrics.sample("gubernator_global_dropped_hits_total") == 0
+    finally:
+        await mgr.close()
+
+
+async def test_redelivery_buffer_bounded_and_drops_counted():
+    metrics = Metrics()
+    peer = FailingPeer()
+    mgr = GlobalManager(
+        FakeInstance(peer),
+        BehaviorConfig(global_sync_wait=0.01),
+        metrics,
+        resilience=ResilienceConfig(redelivery_limit=4),
+    )
+    try:
+        for i in range(10):
+            mgr.queue_hit(req(key=f"b-{i}", behavior=Behavior.GLOBAL))
+        for _ in range(200):
+            if metrics.sample("gubernator_global_dropped_hits_total") > 0:
+                break
+            await asyncio.sleep(0.01)
+        # 10 distinct keys flushed into a failing peer with cap 4: the
+        # buffer holds at most 4, the rest are dropped AND counted.
+        assert len(mgr._hits) <= 4
+        assert metrics.sample("gubernator_global_dropped_hits_total") >= 6
+        assert metrics.sample("gubernator_global_send_queue_length") == \
+            len(mgr._hits)
+    finally:
+        await mgr.close()
+
+
+async def test_broadcast_requeues_failed_updates():
+    metrics = Metrics()
+    peer = FailingPeer()
+    mgr = GlobalManager(
+        FakeInstance(peer),
+        BehaviorConfig(global_sync_wait=0.01),
+        metrics,
+        resilience=ResilienceConfig(redelivery_limit=100),
+    )
+    try:
+        mgr.queue_update(req(key="u-1", behavior=Behavior.GLOBAL))
+        for _ in range(200):
+            if metrics.sample(
+                "gubernator_global_redelivered_broadcasts_total"
+            ) > 0:
+                break
+            await asyncio.sleep(0.01)
+        assert metrics.sample(
+            "gubernator_global_redelivered_broadcasts_total") >= 1
+        assert "u-1" in {r.unique_key for r in mgr._updates.values()}
+        assert metrics.sample("gubernator_global_queue_length") == \
+            len(mgr._updates)
+    finally:
+        await mgr.close()
+
+
+async def test_hits_loop_crash_restarts_and_keeps_flushing():
+    """A crashed hits loop must restart (counted) and keep reconciling."""
+    inst = await V1Instance.create(
+        InstanceConfig(
+            behaviors=BehaviorConfig(global_sync_wait=0.01, batch_wait=0.001),
+            cache_size=256,
+        )
+    )
+    try:
+        orig = inst.global_mgr._send_hits
+        state = {"n": 0}
+
+        async def flaky(hits):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("chaos: flush crashed")
+            await orig(hits)
+
+        inst.global_mgr._send_hits = flaky
+        inst.global_mgr.queue_hit(
+            req(name="crash", key="c1", hits=1, behavior=Behavior.GLOBAL)
+        )
+        for _ in range(300):
+            if inst.metrics.sample(
+                "gubernator_loop_restarts_total", {"loop": "global_hits"}
+            ) >= 1:
+                break
+            await asyncio.sleep(0.01)
+        assert inst.metrics.sample(
+            "gubernator_loop_restarts_total", {"loop": "global_hits"}
+        ) >= 1
+        # The restarted loop still reconciles: a new hit lands locally
+        # (standalone instance → apply_self path).
+        inst.global_mgr.queue_hit(
+            req(name="crash", key="c2", hits=3, limit=10,
+                behavior=Behavior.GLOBAL)
+        )
+
+        async def settled():
+            while True:
+                out = await inst.apply_local(
+                    [req(name="crash", key="c2", hits=0, limit=10)]
+                )
+                if out[0].remaining == 7:
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(settled(), timeout=5)
+        for t in inst.global_mgr._tasks:
+            assert not t.done()
+    finally:
+        await inst.close()
+
+
+# ---------------------------------------------------------------------------
+# Health: breaker quorum rule (satellite)
+# ---------------------------------------------------------------------------
+async def test_health_unhealthy_when_majority_breakers_open():
+    inst = await V1Instance.create(InstanceConfig(cache_size=256))
+    try:
+        inst.set_peers([
+            PeerInfo(grpc_address=f"10.0.0.{i}:81") for i in range(1, 4)
+        ])
+        assert inst.health_check().status == "healthy"
+        peers = inst.get_peer_list()
+        peers[0].breaker.force_open(60.0)
+        # 1/3 open: still healthy (not a majority).
+        assert inst.health_check().status == "healthy"
+        peers[1].breaker.force_open(60.0)
+        h = inst.health_check()
+        assert h.status == "unhealthy"
+        assert "open circuit breakers" in h.message
+    finally:
+        await inst.close()
+
+
+async def test_healthz_returns_503_on_open_breaker_majority():
+    import aiohttp
+
+    from gubernator_tpu.cluster import Cluster
+
+    c = await Cluster.start(1, http_gateway=True)
+    try:
+        addr = c.daemons[0].conf.http_listen_address
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{addr}/healthz") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "healthy"
+            # The single daemon's only local peer is itself: one open
+            # breaker is a majority.
+            c.daemons[0].instance.get_peer_list()[0].breaker.force_open(60.0)
+            async with s.get(f"http://{addr}/healthz") as resp:
+                assert resp.status == 503
+                body = await resp.json()
+                assert body["status"] == "unhealthy"
+                assert "open circuit breakers" in body["message"]
+    finally:
+        await c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Forward path: ownership re-resolution (satellite)
+# ---------------------------------------------------------------------------
+class ScriptedPeer:
+    """Peer whose get_peer_rate_limit follows a scripted outcome list."""
+
+    def __init__(self, addr, outcomes):
+        self.info = PeerInfo(grpc_address=addr)
+        self.outcomes = list(outcomes)
+        self.received = []
+        self.breaker = CircuitBreaker(name=addr)
+
+    async def get_peer_rate_limit(self, r):
+        out = self.outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        self.received.append(r)
+        return out
+
+
+async def test_forward_reresolution_lands_hit_exactly_once():
+    """DEADLINE_EXCEEDED from the old owner + a fresh get_peer returning
+    the new owner must land the hit exactly once on the new owner."""
+    inst = await V1Instance.create(
+        InstanceConfig(
+            behaviors=BehaviorConfig(batch_wait=0.001), cache_size=256,
+        )
+    )
+    try:
+        old = ScriptedPeer("10.0.0.1:81", [
+            rpc_error(grpc.StatusCode.DEADLINE_EXCEEDED, "old owner hung"),
+        ])
+        new = ScriptedPeer("10.0.0.2:81", [
+            RateLimitResponse(limit=10, remaining=9),
+        ])
+        inst.get_peer = lambda key: new  # ownership moved by re-resolution
+        r = req(name="move", key="mk")
+        resp = await inst._async_request(old, r, r.hash_key())
+        assert resp.error == ""
+        assert resp.metadata.get("owner") == "10.0.0.2:81"
+        # Exactly one landing: the old owner never recorded the hit, the
+        # new owner saw it exactly once, and exactly one retry happened.
+        assert old.received == []
+        assert not old.outcomes and not new.outcomes
+        assert len(new.received) == 1
+        assert new.received[0].hits == 1
+        assert inst.metrics.sample(
+            "gubernator_batch_send_retries_total") == 1
+    finally:
+        await inst.close()
+
+
+async def test_forward_retries_use_backoff_and_give_up():
+    """Exhausted retries surface the reference's 'not connected' error;
+    every retry waited a decorrelated-jitter delay (patched here to count
+    instead of sleep)."""
+    inst = await V1Instance.create(
+        InstanceConfig(
+            behaviors=BehaviorConfig(batch_wait=0.001),
+            cache_size=256,
+            resilience=ResilienceConfig(
+                forward_max_attempts=3,
+                forward_backoff_base=0.001,
+                forward_backoff_cap=0.004,
+            ),
+        )
+    )
+    try:
+        boom = rpc_error(grpc.StatusCode.UNAVAILABLE, "down")
+        dead = ScriptedPeer("10.0.0.1:81", [boom] * 10)
+        inst.get_peer = lambda key: dead
+        r = req(name="dead", key="dk")
+        resp = await inst._async_request(dead, r, r.hash_key())
+        assert "not connected" in resp.error
+        assert inst.metrics.sample(
+            "gubernator_batch_send_retries_total") == 4  # attempts 1..4
+    finally:
+        await inst.close()
+
+
+async def test_forward_global_degrades_to_local_on_open_breaker():
+    """An open breaker on the owner must not error a GLOBAL caller: the
+    local non-owner answer serves (counted as degraded), and the hit is
+    queued for redelivery."""
+    inst = await V1Instance.create(
+        InstanceConfig(
+            behaviors=BehaviorConfig(batch_wait=0.001, global_sync_wait=5.0),
+            cache_size=256,
+        )
+    )
+    try:
+        owner = ScriptedPeer("10.0.0.1:81", [])
+        owner.breaker.force_open(60.0)
+
+        async def open_breaker_rpc(r):
+            raise BreakerOpenError("circuit breaker open")
+
+        owner.get_peer_rate_limit = open_breaker_rpc
+        r = req(name="deg", key="gk", hits=2, limit=10,
+                behavior=Behavior.GLOBAL)
+        resp = await inst._async_request(owner, r, r.hash_key())
+        assert resp.error == ""
+        assert resp.remaining == 8  # answered from local state
+        assert resp.metadata.get("degraded") == "true"
+        assert inst.metrics.sample("gubernator_degraded_answers_total") == 1
+        # The hit sits in the redelivery queue for when the owner recovers.
+        assert r.hash_key() in inst.global_mgr._hits
+    finally:
+        await inst.close()
