@@ -1337,6 +1337,155 @@ def rung_chaos():
 
 
 # ----------------------------------------------------------------------
+# Restart-recovery rung: traffic -> SIGTERM -> restart -> verify, plus a
+# ring-swap ownership handoff — both losses gated at exactly 0
+# ----------------------------------------------------------------------
+async def _restart_bench():
+    """Crash-safe persistence acceptance (docs/persistence.md): (1) a
+    daemon with snapshots enabled takes traffic, drains gracefully (the
+    SIGTERM path), and a restart from the same directory must account
+    every hit — ``restart_state_loss`` is the exact number of keys whose
+    consumed budget regressed; (2) a 3-node cluster swaps its ring out
+    from under a GLOBAL owner and the accumulated state must continue on
+    the new owner — ``ownership_transfer_loss`` is the exact number of
+    hits that reset.  check_bench_regression.py gates both at 0
+    absolutely (a restart or ring change that forgets accounting is a
+    rate-limit bypass, baseline or not)."""
+    import tempfile
+
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.config import BehaviorConfig, Config, DaemonConfig
+    from gubernator_tpu.transport.daemon import Daemon
+    from gubernator_tpu.types import Behavior, RateLimitRequest
+
+    snap_dir = tempfile.mkdtemp(prefix="guber-restart-bench-")
+
+    def dconf():
+        conf = DaemonConfig(
+            grpc_listen_address="127.0.0.1:0",
+            http_listen_address="",
+            peer_discovery_type="none",
+        )
+        conf.config = Config(
+            cache_size=1 << 13, snapshot_dir=snap_dir,
+            snapshot_interval=0.05,
+        )
+        return conf
+
+    def lreq(key, hits):
+        return RateLimitRequest(
+            name="restart", unique_key=key, hits=hits, limit=1_000_000,
+            duration=3_600_000,
+        )
+
+    # --- Part 1: traffic -> graceful drain -> restart -> verify -------
+    n_keys = 64 if FAST else 256
+    hits_per_key = 3
+    d = Daemon(dconf())
+    await d.start()
+    await d.wait_for_connect()
+    client = d.client()
+    t0 = time.perf_counter()
+    for i in range(n_keys):
+        out = await client.get_rate_limits([lreq(f"k{i}", hits_per_key)])
+        if out[0].error:
+            raise RuntimeError(out[0].error)
+    traffic_dt = time.perf_counter() - t0
+    await client.close()
+    t0 = time.perf_counter()
+    await d.close()  # the SIGTERM handler's path: drain + final base
+    drain_s = time.perf_counter() - t0
+
+    d2 = Daemon(dconf())
+    t0 = time.perf_counter()
+    await d2.start()
+    restore_s = time.perf_counter() - t0
+    await d2.wait_for_connect()
+    c2 = d2.client()
+    out = await c2.get_rate_limits(
+        [lreq(f"k{i}", 0) for i in range(n_keys)]
+    )
+    await c2.close()
+    restart_loss = sum(
+        1 for r in out if 1_000_000 - r.remaining != hits_per_key
+    )
+    restored_items = d2.instance.restore_stats.get("restored_items", 0)
+    await d2.close()
+
+    # --- Part 2: ring swap -> ownership handoff -> verify -------------
+    behaviors = BehaviorConfig(global_sync_wait=0.02, batch_wait=0.001)
+    c = await Cluster.start(3, behaviors=behaviors)
+    transfer_loss = 0
+    transferred = 0
+    try:
+        name, key = "restartbench", "ok"
+        owner = c.find_owning_daemon(name, key)
+        oi = c.daemons.index(owner)
+        sent = 20 if FAST else 60
+
+        def greq(hits):
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=1_000_000,
+                duration=3_600_000, behavior=Behavior.GLOBAL,
+            )
+
+        oc = owner.client()
+        for _ in range(sent):
+            out = await oc.get_rate_limits([greq(1)])
+            if out[0].error:
+                raise RuntimeError(out[0].error)
+        await oc.close()
+
+        new_peers = [
+            p for p in c.peers
+            if p.grpc_address != owner.conf.grpc_listen_address
+        ]
+        for dmn in c.daemons:
+            dmn.set_peers(new_peers)
+        new_owner_peer = owner.instance.get_peer(f"{name}_{key}")
+        new_owner = next(
+            dmn for dmn in c.daemons
+            if dmn.conf.grpc_listen_address
+            == new_owner_peer.info.grpc_address
+        )
+        nc = new_owner.client()
+        landed = 0
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline:
+            r = (await nc.get_rate_limits([greq(0)]))[0]
+            landed = 1_000_000 - r.remaining
+            if landed >= sent:
+                break
+            await asyncio.sleep(0.02)
+        await nc.close()
+        transfer_loss = int(sent - landed)
+        transferred = owner.metrics.sample(
+            "gubernator_tpu_ownership_transfers_total",
+            {"result": "pushed"})
+    finally:
+        await c.stop()
+
+    import shutil
+
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    return {
+        "rung": "restart_recovery",
+        "keys": n_keys,
+        "requests_per_sec": round(n_keys / traffic_dt, 1),
+        "restart_state_loss": int(restart_loss),
+        "ownership_transfer_loss": transfer_loss,
+        "restored_items": int(restored_items),
+        "transferred_keys": transferred,
+        "drain_s": round(drain_s, 3),
+        "restore_s": round(restore_s, 3),
+    }
+
+
+def rung_restart_recovery():
+    return asyncio.run(_restart_bench())
+
+
+# ----------------------------------------------------------------------
 # Sharded-table mesh rung (8 virtual devices, CPU backend, subprocess)
 # ----------------------------------------------------------------------
 def child_mesh_tick():
@@ -1766,6 +1915,7 @@ def main():
 
     ladder.append(_safe("service_grpc", rung_service))
     ladder.append(_safe("chaos_redelivery", rung_chaos))
+    ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
@@ -1918,7 +2068,8 @@ def compact_headline(record, ladder_file):
     count_keys = (
         "dispatches_per_step", "churn_continuity_errors",
         "promote_dispatches_per_hit_tick", "demote_readbacks_per_reclaim",
-        "hit_redelivery_loss",
+        "hit_redelivery_loss", "restart_state_loss",
+        "ownership_transfer_loss",
     )
     count_map = {}
     for r in record["ladder"]:

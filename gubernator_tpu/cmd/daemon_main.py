@@ -35,10 +35,23 @@ async def run(config_file: str) -> None:
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+
+    def _on_signal(signame: str) -> None:
+        logging.getLogger("gubernator").info(
+            "received %s: draining (readiness -> 503, flushing GLOBAL "
+            "buffers, final snapshot)", signame,
+        )
+        stop.set()
+
     for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, stop.set)
+        loop.add_signal_handler(sig, _on_signal, sig.name)
     await stop.wait()
+    # Graceful drain (docs/persistence.md): close flips /readyz to 503,
+    # flushes the GLOBAL hit/broadcast/redelivery buffers under the
+    # GUBER_DRAIN_TIMEOUT budget, writes the final base snapshot, then
+    # stops the listeners — a drained exit loses zero accounting.
     await daemon.close()
+    logging.getLogger("gubernator").info("drain complete; exiting")
 
 
 def main(argv=None) -> int:

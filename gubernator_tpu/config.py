@@ -113,6 +113,21 @@ class Config:
     tpu_global_mesh_node: int = -1
     tpu_global_mesh_capacity: int = 1 << 16
 
+    # Crash-safe bucket-state persistence (docs/persistence.md): when
+    # GUBER_SNAPSHOT_DIR names a directory, a supervised background loop
+    # appends CRC'd dirty-delta snapshots every GUBER_SNAPSHOT_INTERVAL
+    # and compacts them into a fresh base every
+    # GUBER_SNAPSHOT_DELTAS_PER_BASE records; startup restores base +
+    # deltas before serving.  Empty = persistence off (the seed
+    # behavior: restart is amnesia unless a Loader is wired).
+    snapshot_dir: str = ""
+    snapshot_interval: float = 5.0
+    snapshot_deltas_per_base: int = 64
+    # Graceful-drain budget (seconds): bounds the final GLOBAL
+    # hit/broadcast/redelivery flush inside GlobalManager.close so a
+    # dead peer can't wedge shutdown.  GUBER_DRAIN_TIMEOUT
+    drain_timeout: float = 2.0
+
     # Fault-tolerant peer path (docs/resilience.md): per-peer circuit
     # breakers, forward-retry backoff, and the GLOBAL redelivery buffer.
     # GUBER_BREAKER_* / GUBER_FORWARD_* / GUBER_REDELIVERY_LIMIT.
@@ -405,6 +420,12 @@ def setup_daemon_config(
         fault_injector=FaultInjector.from_env(r),
         cache_size=r.int_("GUBER_CACHE_SIZE", 50_000),
         cold_cache_size=r.int_("GUBER_COLD_CACHE_SIZE", 0),
+        snapshot_dir=r.str_("GUBER_SNAPSHOT_DIR"),
+        snapshot_interval=r.float_seconds("GUBER_SNAPSHOT_INTERVAL", 5.0),
+        snapshot_deltas_per_base=r.int_(
+            "GUBER_SNAPSHOT_DELTAS_PER_BASE", 64
+        ),
+        drain_timeout=r.float_seconds("GUBER_DRAIN_TIMEOUT", 2.0),
         data_center=r.str_("GUBER_DATA_CENTER"),
         local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
@@ -430,6 +451,20 @@ def setup_daemon_config(
     if conf.cold_cache_size < 0:
         raise ValueError(
             f"GUBER_COLD_CACHE_SIZE must be >= 0; got {conf.cold_cache_size}"
+        )
+    if conf.snapshot_interval <= 0:
+        raise ValueError(
+            f"GUBER_SNAPSHOT_INTERVAL must be > 0; "
+            f"got {conf.snapshot_interval}"
+        )
+    if conf.snapshot_deltas_per_base < 1:
+        raise ValueError(
+            f"GUBER_SNAPSHOT_DELTAS_PER_BASE must be >= 1; "
+            f"got {conf.snapshot_deltas_per_base}"
+        )
+    if conf.drain_timeout < 0:
+        raise ValueError(
+            f"GUBER_DRAIN_TIMEOUT must be >= 0; got {conf.drain_timeout}"
         )
     if not 0.0 < resilience.breaker_failure_threshold <= 1.0:
         raise ValueError(

@@ -56,6 +56,13 @@ class GlobalManager:
         self.resilience = resilience or ResilienceConfig()
         self._hits: Dict[str, RateLimitRequest] = {}
         self._updates: Dict[str, RateLimitRequest] = {}
+        # GLOBAL keys this node has answered as owner, key → prototype
+        # request (algorithm/limit/duration — what a state re-read
+        # needs).  The ownership-handoff working set: after a ring swap,
+        # keys here whose new owner is a different peer get their
+        # accumulated state pushed to that peer (transfer_ownership).
+        # Bounded by the redelivery cap like the other buffers.
+        self._owned: Dict[str, RateLimitRequest] = {}
         self._hits_kick = asyncio.Event()
         self._updates_kick = asyncio.Event()
         self._running = True
@@ -100,7 +107,10 @@ class GlobalManager:
         """Record an owner-side state change for broadcast (global.go:80-84)."""
         if req.hits == 0:
             return
-        self._updates[req.hash_key()] = req
+        key = req.hash_key()
+        self._updates[key] = req
+        if key in self._owned or len(self._owned) < self.resilience.redelivery_limit:
+            self._owned[key] = req
         if self.metrics is not None:
             self.metrics.global_queue_length.set(len(self._updates))
         self._updates_kick.set()
@@ -335,8 +345,132 @@ class GlobalManager:
         if redelivered:
             self._updates_kick.set()
 
-    async def close(self) -> None:
+    # ------------------------------------------------------------------
+    # Ownership handoff (ring churn) and graceful drain
+    # ------------------------------------------------------------------
+    async def transfer_ownership(self) -> int:
+        """Push accumulated GLOBAL state to new owners after a ring swap.
+
+        For every tracked owned key whose ``get_peer`` now resolves to a
+        *different* peer: re-read current local state (hits=0 query, the
+        broadcast's authoritative-read pattern) and install it on the new
+        owner via ``UpdatePeerGlobals`` — the key keeps counting from its
+        accumulated level instead of resetting (the process-scope twin of
+        the tiering fresh-bucket fix).  A failed push re-enqueues the
+        source update into the bounded broadcast redelivery buffer, whose
+        next flush re-reads state and pushes to every peer — a slow new
+        owner delays the transfer, never loses it.  Returns the number of
+        keys pushed."""
+        moved: List[tuple] = []  # (key, proto)
+        for key in list(self._owned):
+            try:
+                peer = self.instance.get_peer(key)
+            except Exception:
+                continue
+            if peer is None or peer.info.is_owner:
+                continue  # still ours (or standalone)
+            moved.append((key, self._owned.pop(key)))
+        if not moved:
+            return 0
+        queries = []
+        for _, proto in moved:
+            q = RateLimitRequest(**vars(proto))
+            q.hits = 0
+            q.behavior = set_behavior(q.behavior, Behavior.GLOBAL, False)
+            queries.append(q)
+        statuses = await self.instance.apply_local(queries)
+        by_peer: Dict[str, tuple] = {}
+        for (key, proto), st in zip(moved, statuses):
+            if st.error:
+                continue
+            # A bucket answering UNDER with full remaining carries no
+            # accumulated state worth shipping — but shipping it is
+            # harmless (idempotent install), so no filtering beyond
+            # errors: simpler and covers RESET_REMAINING edge states.
+            peer = self.instance.get_peer(key)
+            if peer is None or peer.info.is_owner:
+                continue  # ring moved again mid-read; next swap retries
+            upd = GlobalUpdate(
+                key=key,
+                status=st,
+                algorithm=proto.algorithm,
+                duration=proto.duration,
+                created_at=proto.created_at or 0,
+            )
+            by_peer.setdefault(
+                peer.info.grpc_address, (peer, [], [])
+            )
+            by_peer[peer.info.grpc_address][1].append(upd)
+            by_peer[peer.info.grpc_address][2].append(proto)
+        pushed = 0
+        limit = self.conf.global_batch_limit
+
+        async def push(peer, updates, protos):
+            nonlocal pushed
+            for i in range(0, len(updates), limit):
+                chunk = updates[i : i + limit]
+                try:
+                    await peer.update_peer_globals(chunk)
+                except Exception:
+                    # The new owner is slow/unreachable: the transfer
+                    # rides the broadcast redelivery buffer instead of
+                    # vanishing — its next flush re-reads and re-pushes.
+                    if self.metrics is not None:
+                        self.metrics.ownership_transfers.labels(
+                            result="requeued"
+                        ).inc(len(chunk))
+                    self._requeue_updates(protos[i : i + limit])
+                    continue
+                pushed += len(chunk)
+                if self.metrics is not None:
+                    self.metrics.ownership_transfers.labels(
+                        result="pushed"
+                    ).inc(len(chunk))
+
+        await asyncio.gather(
+            *(push(p, u, pr) for p, u, pr in by_peer.values())
+        )
+        if pushed:
+            log.info("ring change: transferred %d GLOBAL keys to new "
+                     "owners", pushed)
+        return pushed
+
+    async def _final_flush(self) -> None:
+        """Drain everything still buffered — pending hits, pending/
+        redelivery updates — through the normal flush paths.  Failed
+        chunks requeue themselves; a few bounded rounds give flapping
+        peers a second chance while the caller's deadline caps the total
+        (a permanently dead peer exhausts the rounds, not the process)."""
+        for _ in range(4):
+            if not (self._hits or self._updates):
+                return
+            hits, self._hits = self._hits, {}
+            updates, self._updates = self._updates, {}
+            if hits:
+                await self._send_hits(list(hits.values()))
+            if updates:
+                await self._broadcast(list(updates.values()))
+            if (len(self._hits) >= len(hits)
+                    and len(self._updates) >= len(updates)):
+                return  # everything requeued: peers are gone, stop early
+
+    async def close(self, drain_timeout: float = 0.0) -> None:
+        """Stop the loops, then (graceful-drain path) flush the GLOBAL
+        hit/broadcast/redelivery buffers under a bounded deadline so the
+        accounting lands on the owners instead of dying with the process
+        — but a dead peer can never wedge shutdown past the budget."""
         self._running = False
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        if drain_timeout > 0 and (self._hits or self._updates):
+            try:
+                await asyncio.wait_for(self._final_flush(), drain_timeout)
+            except asyncio.TimeoutError:
+                log.warning(
+                    "graceful drain deadline (%.1fs) expired with %d hits"
+                    " / %d updates unflushed",
+                    drain_timeout, len(self._hits), len(self._updates),
+                )
+            except Exception:
+                log.exception("graceful drain flush failed")

@@ -76,6 +76,13 @@ class InstanceConfig:
     tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
     tpu_bg_reclaim: str = "auto"         # background reclamation (engine.py)
     cold_cache_size: int = 0             # tiered cold store (docs/tiering.md)
+    # Crash-safe persistence (docs/persistence.md): snapshot directory
+    # (empty = off), delta-flush cadence, compaction threshold, and the
+    # graceful-drain budget for GlobalManager.close.
+    snapshot_dir: str = ""
+    snapshot_interval: float = 5.0
+    snapshot_deltas_per_base: int = 64
+    drain_timeout: float = 2.0
     # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
     # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
     # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
@@ -111,6 +118,10 @@ class InstanceConfig:
             tpu_table_layout=conf.tpu_table_layout,
             tpu_bg_reclaim=conf.tpu_bg_reclaim,
             cold_cache_size=conf.cold_cache_size,
+            snapshot_dir=conf.snapshot_dir,
+            snapshot_interval=conf.snapshot_interval,
+            snapshot_deltas_per_base=conf.snapshot_deltas_per_base,
+            drain_timeout=conf.drain_timeout,
             tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
             tpu_global_mesh_node=conf.tpu_global_mesh_node,
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
@@ -231,6 +242,15 @@ class V1Instance:
             self._mesh_task = asyncio.create_task(
                 self._mesh_reconcile_loop(), name="global-mesh-reconcile"
             )
+        # Doomed-peer shutdowns and ring-change ownership transfers run
+        # as tasks (set_peers is sync); tracked here so close() awaits
+        # them instead of abandoning work (and so tests can assert no
+        # pending-task warnings).
+        self._peer_shutdown_tasks: set = set()
+        self._transfer_tasks: set = set()
+        # Crash-safe persistence (docs/persistence.md): wired by create().
+        self._snapshot_writer = None
+        self.restore_stats: dict = {}
         self._closed = False
 
     @classmethod
@@ -247,7 +267,50 @@ class V1Instance:
             else:
                 items = conf.loader.load()
                 inst.engine.load_items(list(items))
+        if conf.snapshot_dir and hasattr(inst.engine, "load_columns"):
+            await inst._start_persistence()
         return inst
+
+    async def _start_persistence(self) -> None:
+        """Restore base + deltas from the snapshot store (corrupt tails
+        are counted, never fatal; ``load_columns`` TTL-expires stale
+        rows), then start the supervised delta-flush loop.  Runs before
+        the daemon flips ready — a restoring node answers 503 on
+        /readyz, not fresh-bucket allows."""
+        from gubernator_tpu.persistence import SnapshotStore, SnapshotWriter
+
+        store = SnapshotStore(self.conf.snapshot_dir)
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(None, store.load)
+        for snap in result.snapshots:
+            await loop.run_in_executor(None, self.engine.load_columns, snap)
+        self.restore_stats = {
+            "generation": result.generation,
+            "restored_items": result.items,
+            "delta_records": result.delta_records,
+            "corrupt_records": result.corrupt_records,
+            "manifest_missing": result.manifest_missing,
+        }
+        if result.corrupt_records:
+            self.metrics.snapshot_corrupt_records.inc(result.corrupt_records)
+            self.log.warning(
+                "snapshot restore skipped %d corrupt/truncated records "
+                "(kept the last good prefix)", result.corrupt_records,
+            )
+        if result.items:
+            self.metrics.snapshot_restored_items.inc(result.items)
+            self.log.info(
+                "restored %d bucket rows from %s (generation %d, %d "
+                "delta records)", result.items, self.conf.snapshot_dir,
+                result.generation, result.delta_records,
+            )
+        self._snapshot_writer = SnapshotWriter(
+            self.engine, store,
+            interval=self.conf.snapshot_interval,
+            deltas_per_base=self.conf.snapshot_deltas_per_base,
+            metrics=self.metrics,
+        )
+        self._snapshot_writer.start()
 
     # ------------------------------------------------------------------
     # Public API: GetRateLimits
@@ -782,11 +845,40 @@ class V1Instance:
                 for p in picker.peers()
                 if region.get_by_address(p.info.grpc_address) is None
             )
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (tests building instances synchronously)
         for p in doomed:
-            try:
-                asyncio.get_running_loop().create_task(p.shutdown())
-            except RuntimeError:
-                pass  # no loop (tests building instances synchronously)
+            # Tracked, not fire-and-forget: close() awaits these, and a
+            # failed shutdown is logged instead of silently swallowed
+            # (a bare create_task drops the exception with the task).
+            t = loop.create_task(
+                self._shutdown_peer(p),
+                name=f"peer-shutdown:{p.info.grpc_address}",
+            )
+            self._peer_shutdown_tasks.add(t)
+            t.add_done_callback(self._peer_shutdown_tasks.discard)
+        # Ownership handoff: GLOBAL keys we owned whose new owner is a
+        # different peer get their accumulated state pushed there (the
+        # ring swap must not reset their accounting).  Skipped when no
+        # owned keys are tracked — the overwhelmingly common set_peers.
+        if self.global_mgr._owned:
+            t = loop.create_task(
+                self.global_mgr.transfer_ownership(),
+                name="ownership-transfer",
+            )
+            self._transfer_tasks.add(t)
+            t.add_done_callback(self._transfer_tasks.discard)
+
+    async def _shutdown_peer(self, peer: PeerClient) -> None:
+        try:
+            await peer.shutdown()
+        except Exception:
+            self.log.warning(
+                "shutdown of removed peer %s failed",
+                peer.info.grpc_address, exc_info=True,
+            )
 
     def _new_peer_client(self, info: PeerInfo) -> PeerClient:
         return PeerClient(
@@ -812,22 +904,44 @@ class V1Instance:
     # Lifecycle
     # ------------------------------------------------------------------
     async def close(self) -> None:
-        """Stop loops, drain peers, run Loader.Save (gubernator.go:151-170)."""
+        """Graceful drain + shutdown (gubernator.go:151-170, extended per
+        docs/persistence.md): finish in-flight ring work (ownership
+        transfers), flush the GLOBAL buffers under the bounded drain
+        deadline, stop peers (awaiting the tracked teardown tasks), write
+        the final full base snapshot / run Loader.Save, then stop the
+        tick loop and engine."""
         if self._closed:
             return
         self._closed = True
-        await self.global_mgr.close()
+        # Pending ownership transfers need peers and the tick loop alive.
+        if self._transfer_tasks:
+            await asyncio.gather(
+                *list(self._transfer_tasks), return_exceptions=True
+            )
+        await self.global_mgr.close(drain_timeout=self.conf.drain_timeout)
         if self._mesh_task is not None:
             self._mesh_task.cancel()
             try:
                 await self._mesh_task
             except (asyncio.CancelledError, Exception):
                 pass
+        # Earlier ring changes spawned doomed-peer shutdowns; await them
+        # (each logs its own failure) so no task outlives the instance.
+        if self._peer_shutdown_tasks:
+            await asyncio.gather(
+                *list(self._peer_shutdown_tasks), return_exceptions=True
+            )
         for p in set(self.local_picker.peers()) | set(self.region_picker.peers()):
             try:
                 await p.shutdown()
             except Exception:
-                pass
+                self.log.warning(
+                    "peer %s shutdown failed during close",
+                    p.info.grpc_address, exc_info=True,
+                )
+        if self._snapshot_writer is not None:
+            # Final FULL base: graceful shutdown loses zero state.
+            await self._snapshot_writer.close(final_base=True)
         if self.conf.loader is not None:
             if hasattr(self.conf.loader, "save_columns") and hasattr(
                 self.engine, "export_columns"

@@ -280,6 +280,13 @@ class Daemon:
         self._pool = None
         self.tls: Optional[TLSBundle] = None
         self.peer_info: List[PeerInfo] = []
+        # Readiness is distinct from liveness (docs/persistence.md):
+        # /readyz is 503 until the startup restore completed and flips
+        # back to 503 the moment graceful drain begins, so orchestrators
+        # stop routing new traffic while /healthz (liveness + breaker
+        # quorum) stays truthful about the process itself.
+        self._ready = False
+        self._draining = False
 
     # ------------------------------------------------------------------
     @property
@@ -315,6 +322,11 @@ class Daemon:
         host = self.conf.grpc_listen_address.rsplit(":", 1)[0]
         self.conf.grpc_listen_address = f"{host}:{port}"
 
+        # Gateway comes up BEFORE the instance: a snapshot restore can
+        # take seconds, and readiness probes must get a real 503 from
+        # /readyz during it (not connection-refused ambiguity).
+        await self._start_gateway()
+
         # The instance needs the *bound* address so set_peers can recognize
         # this node's own entry and mark it owner — create it only now.
         iconf = InstanceConfig.from_config(
@@ -338,8 +350,8 @@ class Daemon:
         )
         await server.start()
         self._grpc_server = server
+        self._ready = True
 
-        await self._start_gateway()
         await self._start_discovery()
         log.info(
             "gubernator-tpu daemon up: grpc=%s http=%s",
@@ -355,6 +367,7 @@ class Daemon:
         app.router.add_post("/v1/GetRateLimits", self._h_get_rate_limits)
         app.router.add_get("/v1/HealthCheck", self._h_health_check)
         app.router.add_get("/healthz", self._h_health_check)
+        app.router.add_get("/readyz", self._h_readyz)
         if include_metrics:
             app.router.add_get("/metrics", self._h_metrics)
         return app
@@ -382,6 +395,7 @@ class Daemon:
             sapp = web.Application()
             sapp.router.add_get("/v1/HealthCheck", self._h_health_check)
             sapp.router.add_get("/healthz", self._h_health_check)
+            sapp.router.add_get("/readyz", self._h_readyz)
             sapp.router.add_get("/metrics", self._h_metrics)
             srunner = web.AppRunner(sapp, access_log=None)
             await srunner.setup()
@@ -392,6 +406,10 @@ class Daemon:
     async def _h_get_rate_limits(self, request: web.Request) -> web.Response:
         """JSON gateway with snake_case field names (UseProtoNames parity,
         daemon.go:251-261)."""
+        if self.instance is None:
+            return web.json_response(
+                {"error": "starting up", "code": 14}, status=503
+            )
         try:
             body = await request.read()
             msg = json_format.Parse(body, pb.GetRateLimitsReq())
@@ -417,7 +435,26 @@ class Daemon:
             )
         )
 
+    async def _h_readyz(self, request: web.Request) -> web.Response:
+        """Readiness, split from liveness: 503 before the startup restore
+        completes and for the whole graceful drain, 200 only while the
+        daemon wants new traffic.  /healthz keeps the breaker-majority
+        liveness semantics (docs/resilience.md)."""
+        ok = self._ready and not self._draining
+        body = {
+            "ready": ok,
+            "draining": self._draining,
+        }
+        if self.instance is not None and self.instance.restore_stats:
+            body["restore"] = self.instance.restore_stats
+        return web.json_response(body, status=200 if ok else 503)
+
     async def _h_health_check(self, request: web.Request) -> web.Response:
+        if self.instance is None:
+            return web.json_response(
+                {"status": "unhealthy", "message": "starting up",
+                 "peer_count": 0}, status=503
+            )
         h = self.instance.health_check()
         msg = pb.HealthCheckResp(
             status=h.status, message=h.message, peer_count=h.peer_count
@@ -438,6 +475,10 @@ class Daemon:
         )
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
+        if self.instance is None:
+            return web.Response(
+                body=self.metrics.expose(), content_type="text/plain"
+            )
         eng = self.instance.engine
         self.metrics.cache_size.set(eng.cache_size())
         if hasattr(eng, "hot_occupancy"):
@@ -529,7 +570,11 @@ class Daemon:
                 await asyncio.sleep(0.05)
 
     async def close(self) -> None:
-        """Graceful shutdown (daemon.go:369-396)."""
+        """Graceful shutdown (daemon.go:369-396): flip readiness to 503
+        first (orchestrators stop routing), then drain — discovery off,
+        GLOBAL buffers flushed under the bounded deadline and the final
+        base snapshot written inside instance.close — then listeners."""
+        self._draining = True
         if self._pool is not None:
             await self._pool.close()
         if self.instance is not None:

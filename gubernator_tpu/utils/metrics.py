@@ -290,6 +290,53 @@ class Metrics:
             "redelivery buffer was at its cap.",
             registry=reg,
         )
+        # Crash-safe persistence (docs/persistence.md): snapshot write
+        # traffic, restore damage, and GLOBAL ownership handoff on ring
+        # churn.
+        self.snapshot_writes = Counter(
+            "gubernator_tpu_snapshot_writes",
+            "Snapshot records durably written; label \"kind\" is \"delta\" "
+            "(incremental dirty export) or \"base\" (full compaction / "
+            "final shutdown snapshot).",
+            ["kind"],
+            registry=reg,
+        )
+        self.snapshot_items = Counter(
+            "gubernator_tpu_snapshot_items",
+            "Bucket rows carried by durably written snapshot records, "
+            "by record kind.",
+            ["kind"],
+            registry=reg,
+        )
+        self.snapshot_duration = Summary(
+            "gubernator_tpu_snapshot_duration",
+            "Wall time of one snapshot write (engine export + encode + "
+            "fsync) in seconds, by record kind.",
+            ["kind"],
+            registry=reg,
+        )
+        self.snapshot_corrupt_records = Counter(
+            "gubernator_tpu_snapshot_corrupt_records",
+            "Corrupt or truncated snapshot records skipped during "
+            "startup restore (replay stops at the last good prefix; "
+            "the service still starts).",
+            registry=reg,
+        )
+        self.snapshot_restored_items = Counter(
+            "gubernator_tpu_snapshot_restored_items",
+            "Bucket rows replayed from the snapshot store at startup "
+            "(before TTL expiry filtering).",
+            registry=reg,
+        )
+        self.ownership_transfers = Counter(
+            "gubernator_tpu_ownership_transfers",
+            "GLOBAL keys whose accumulated state was handed to a new "
+            "owning peer after a ring change; label \"result\" is "
+            "\"pushed\" (landed on the new owner) or \"requeued\" (push "
+            "failed; retried via the broadcast redelivery buffer).",
+            ["result"],
+            registry=reg,
+        )
         self.loop_restarts = Counter(
             "gubernator_loop_restarts",
             "Background loops (global_hits, global_broadcast, peer_batch) "

@@ -38,18 +38,31 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   hit_redelivery_loss            0   — the chaos rung's partitioned-owner
 #                                        GLOBAL hits all land after recovery
 #                                        (docs/resilience.md redelivery)
+#   restart_state_loss             0   — graceful SIGTERM + restart keeps
+#                                        every key's consumed budget
+#                                        (docs/persistence.md final base)
+#   ownership_transfer_loss        0   — a set_peers ring swap hands owned
+#                                        GLOBAL state to the new owner with
+#                                        no reset (ownership handoff)
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
     "promote_dispatches_per_hit_tick",
     "demote_readbacks_per_reclaim",
     "hit_redelivery_loss",
+    "restart_state_loss",
+    "ownership_transfer_loss",
 )
 
 # Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
 # the rung: each is an absolute correctness invariant, not a relative
 # performance figure.
-ABSOLUTE_ZERO_KEYS = ("churn_continuity_errors", "hit_redelivery_loss")
+ABSOLUTE_ZERO_KEYS = (
+    "churn_continuity_errors",
+    "hit_redelivery_loss",
+    "restart_state_loss",
+    "ownership_transfer_loss",
+)
 
 
 def load_bench(path):

@@ -187,6 +187,39 @@ def test_resilience_env_surface():
     assert c.config.fault_injector is None
 
 
+def test_snapshot_env_surface():
+    """GUBER_SNAPSHOT_* / GUBER_DRAIN_TIMEOUT flow into Config and down
+    to InstanceConfig (docs/persistence.md)."""
+    from gubernator_tpu.service.instance import InstanceConfig
+
+    c = conf_from({
+        "GUBER_SNAPSHOT_DIR": "/tmp/guber-snaps",
+        "GUBER_SNAPSHOT_INTERVAL": "250ms",
+        "GUBER_SNAPSHOT_DELTAS_PER_BASE": "16",
+        "GUBER_DRAIN_TIMEOUT": "3s",
+    })
+    assert c.config.snapshot_dir == "/tmp/guber-snaps"
+    assert c.config.snapshot_interval == pytest.approx(0.25)
+    assert c.config.snapshot_deltas_per_base == 16
+    assert c.config.drain_timeout == pytest.approx(3.0)
+    ic = InstanceConfig.from_config(c.config)
+    assert ic.snapshot_dir == "/tmp/guber-snaps"
+    assert ic.snapshot_interval == pytest.approx(0.25)
+    assert ic.snapshot_deltas_per_base == 16
+    assert ic.drain_timeout == pytest.approx(3.0)
+    # Default: persistence off.
+    assert conf_from({}).config.snapshot_dir == ""
+
+
+def test_snapshot_env_validation():
+    with pytest.raises(ValueError, match="GUBER_SNAPSHOT_INTERVAL"):
+        conf_from({"GUBER_SNAPSHOT_INTERVAL": "0"})
+    with pytest.raises(ValueError, match="GUBER_SNAPSHOT_DELTAS_PER_BASE"):
+        conf_from({"GUBER_SNAPSHOT_DELTAS_PER_BASE": "0"})
+    with pytest.raises(ValueError, match="GUBER_DRAIN_TIMEOUT"):
+        conf_from({"GUBER_DRAIN_TIMEOUT": "-1s"})
+
+
 def test_resilience_env_validation():
     with pytest.raises(ValueError, match="GUBER_BREAKER_FAILURE_THRESHOLD"):
         conf_from({"GUBER_BREAKER_FAILURE_THRESHOLD": "1.5"})

@@ -1,0 +1,451 @@
+"""Crash-safe persistence suite (docs/persistence.md).
+
+Covers the snapshot store's corruption contract (truncated tails, flipped
+CRC bytes, missing manifests all restore to the last good prefix with the
+damage counted, never an exception), delta/compaction mechanics, the
+supervised writer's loss bounds, and the service-level lifecycle: kill
+-and-restore roundtrips preserving leaky-bucket float level and cold-tier
+entries, graceful shutdown's zero-loss final base, /readyz vs /healthz
+split, tracked peer teardown, and GLOBAL ownership handoff on ring churn.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+
+from gubernator_tpu.ops.engine import (
+    SNAP_FIELDS,
+    TickEngine,
+    snapshot_from_items,
+)
+from gubernator_tpu.persistence import (
+    SnapshotStore,
+    SnapshotWriter,
+    decode_snapshot,
+    encode_snapshot,
+)
+from gubernator_tpu.persistence.snapshot import MANIFEST, _delta_name
+from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+from gubernator_tpu.types import RateLimitRequest
+
+FAR = 4_000_000_000_000  # expire_at far in the future (epoch ms)
+
+
+def item(key, remaining=50, remaining_f=0.0, algorithm=0, **kw):
+    base = dict(
+        key=key, algorithm=algorithm, limit=100, remaining=remaining,
+        remaining_f=remaining_f, duration=3_600_000, created_at=1_000,
+        updated_at=2_000, burst=100, status=0, expire_at=FAR,
+    )
+    base.update(kw)
+    return base
+
+
+def snap_of(*items_):
+    return snapshot_from_items(list(items_))
+
+
+def restored_map(result):
+    """Replay a RestoreResult's snapshots host-side: key → last row."""
+    out = {}
+    for snap in result.snapshots:
+        offs = snap["key_offsets"]
+        for j in range(len(offs) - 1):
+            key = bytes(snap["key_blob"][offs[j]: offs[j + 1]]).decode()
+            out[key] = {f: snap[f][j] for f in SNAP_FIELDS}
+    return out
+
+
+# ----------------------------------------------------------------------
+# SnapshotStore unit coverage
+# ----------------------------------------------------------------------
+def test_payload_roundtrip(tmp_path):
+    snap = snap_of(item("a"), item("b", remaining=7, remaining_f=3.25))
+    out = decode_snapshot(encode_snapshot(snap))
+    assert out["key_blob"] == snap["key_blob"]
+    for f in SNAP_FIELDS:
+        np.testing.assert_array_equal(out[f], snap[f])
+
+
+def test_base_plus_deltas_replay_last_wins(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("a", remaining=90), item("b")))
+    store.append_delta(snap_of(item("a", remaining=80)))
+    store.append_delta(snap_of(item("a", remaining=70), item("c")))
+    store.close()
+
+    result = SnapshotStore(str(tmp_path)).load()
+    assert result.corrupt_records == 0
+    assert result.delta_records == 2
+    m = restored_map(result)
+    assert m["a"]["remaining"] == 70     # last delta wins
+    assert set(m) == {"a", "b", "c"}
+
+
+def test_truncated_delta_tail_restores_prefix(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("a")))
+    store.append_delta(snap_of(item("b", remaining=42)))
+    store.append_delta(snap_of(item("c")))
+    store.close()
+    # Kill -9 mid-append: the final record loses its tail.
+    path = tmp_path / _delta_name(1)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 11)
+
+    result = SnapshotStore(str(tmp_path)).load()
+    assert result.corrupt_records == 1
+    m = restored_map(result)
+    assert m["b"]["remaining"] == 42     # prefix survives
+    assert "c" not in m                  # torn tail dropped, no exception
+
+
+def test_flipped_crc_byte_stops_at_corruption(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("a")))
+    store.append_delta(snap_of(item("b")))
+    store.append_delta(snap_of(item("c")))
+    store.close()
+    path = tmp_path / _delta_name(1)
+    with open(path, "r+b") as f:
+        f.seek(30)                        # inside record 1's payload
+        b = f.read(1)
+        f.seek(30)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    result = SnapshotStore(str(tmp_path)).load()
+    assert result.corrupt_records >= 1
+    m = restored_map(result)
+    assert "a" in m and "b" not in m and "c" not in m
+
+
+def test_missing_manifest_scans_for_newest_generation(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("old")))
+    store.append_delta(snap_of(item("x")))
+    store.write_base(snap_of(item("new"), item("x")))  # generation 2
+    store.close()
+    os.unlink(tmp_path / MANIFEST)
+
+    result = SnapshotStore(str(tmp_path)).load()
+    assert result.manifest_missing
+    assert result.generation == 2
+    assert set(restored_map(result)) == {"new", "x"}
+
+
+def test_corrupt_base_falls_back_to_older_generation(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("g1")))
+    store.close()
+    # Manifest pointing at a generation whose base is garbage.
+    with open(tmp_path / "base-00000009.snap", "wb") as f:
+        f.write(b"\x00" * 64)
+    with open(tmp_path / MANIFEST, "w") as f:
+        f.write('{"generation": 9, "base": "base-00000009.snap", '
+                '"delta": "delta-00000009.log"}')
+
+    result = SnapshotStore(str(tmp_path)).load()
+    assert set(restored_map(result)) == {"g1"}
+    assert result.corrupt_records >= 1
+
+
+def test_empty_directory_is_a_fresh_start(tmp_path):
+    result = SnapshotStore(str(tmp_path)).load()
+    assert result.snapshots == []
+    assert result.items == 0
+    assert result.corrupt_records == 0
+
+
+def test_compaction_starts_new_generation_and_retires_old(tmp_path):
+    eng = TickEngine(capacity=256, max_batch=64)
+    try:
+        store = SnapshotStore(str(tmp_path))
+        writer = SnapshotWriter(eng, store, interval=60, deltas_per_base=3)
+        for i in range(3):
+            eng.process([RateLimitRequest(
+                name="t", unique_key=f"k{i}", hits=1, limit=100,
+                duration=3_600_000,
+            )])
+            writer.flush()
+        # Third flush crossed deltas_per_base: compacted into gen+1.
+        assert writer.metric_base_writes == 1
+        assert store.delta_records == 0
+        names = sorted(os.listdir(tmp_path))
+        assert "base-00000001.snap" in names
+        assert "base-00000000.snap" not in names  # retired
+        result = SnapshotStore(str(tmp_path)).load()
+        assert set(restored_map(result)) == {"t_k0", "t_k1", "t_k2"}
+    finally:
+        eng.close()
+
+
+def test_writer_carries_failed_deltas(tmp_path, monkeypatch):
+    eng = TickEngine(capacity=256, max_batch=64)
+    try:
+        store = SnapshotStore(str(tmp_path))
+        writer = SnapshotWriter(eng, store, interval=60, deltas_per_base=99)
+        eng.process([RateLimitRequest(
+            name="t", unique_key="k", hits=5, limit=100, duration=3_600_000,
+        )])
+
+        def boom(snap):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(store, "append_delta", boom)
+        writer.flush()  # dirty set drained into the carry, not lost
+        assert writer.metric_write_failures == 1
+        assert len(writer._carry) == 1
+        monkeypatch.undo()
+        written = writer.flush()
+        assert written == 1 and not writer._carry
+        m = restored_map(SnapshotStore(str(tmp_path)).load())
+        assert m["t_k"]["remaining"] == 95
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# Engine roundtrips: hard kill and cold tier
+# ----------------------------------------------------------------------
+def test_hard_kill_roundtrip_preserves_float_level_and_cold_tier(tmp_path):
+    """One tiered engine, one hard kill: the fsync'd delta + base are all
+    that survive (no close), and the restore must keep the leaky bucket's
+    float level, token counts, AND the cold tier's overflow entries."""
+    now = 1_700_000_000_000
+    # Table smaller than the working set: load_columns overflows the
+    # tail into the cold tier; exports must carry both tiers.
+    eng = TickEngine(capacity=128, max_batch=64, cold_capacity=512)
+    try:
+        n = 200
+        eng.load_columns(snap_of(
+            *[item(f"k{i}", remaining=100 - (i % 50)) for i in range(n)]
+        ), now=now)
+        assert eng.cold_size() > 0
+        store = SnapshotStore(str(tmp_path))
+        store.write_base(eng.export_columns())
+        writer = SnapshotWriter(eng, store, interval=60, deltas_per_base=99)
+        eng.process([
+            RateLimitRequest(name="tok", unique_key="a", hits=7, limit=100,
+                             duration=3_600_000),
+            RateLimitRequest(name="lk", unique_key="b", hits=5, limit=100,
+                             duration=60_000, algorithm=1),
+        ], now=now)
+        writer.flush()
+        # Hard kill: NO final base, no close — the fsync'd records are
+        # all that survive.
+        store.close()
+
+        result = SnapshotStore(str(tmp_path)).load()
+        m = restored_map(result)
+        assert len(m) == n + 2                    # cold entries included
+        assert m["k7"]["remaining"] == 93
+
+        eng2 = TickEngine(capacity=256, max_batch=64)
+        try:
+            for snap in result.snapshots:
+                eng2.load_columns(snap, now=now + 10)
+            out = eng2.process([
+                RateLimitRequest(name="tok", unique_key="a", hits=0,
+                                 limit=100, duration=3_600_000),
+                RateLimitRequest(name="lk", unique_key="b", hits=0,
+                                 limit=100, duration=60_000, algorithm=1),
+            ], now=now + 10)
+            assert out[0].remaining == 93         # token hits survived
+            # Leaky level: 5 hits leaked back ~10ms of a 60s/100 drip —
+            # remaining is 95, not a fresh 100.
+            assert out[1].remaining == 95
+        finally:
+            eng2.close()
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# Service lifecycle
+# ----------------------------------------------------------------------
+def _iconf(tmp_path, **kw):
+    # 256 matches the suite's most common engine capacity, so the table
+    # programs are compile-cache hits instead of fresh shapes.
+    kw.setdefault("cache_size", 256)
+    kw.setdefault("tpu_platform", "cpu")
+    kw.setdefault("snapshot_dir", str(tmp_path))
+    kw.setdefault("snapshot_interval", 0.05)
+    return InstanceConfig(**kw)
+
+
+async def test_restore_increments_corrupt_metric_and_serves(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write_base(snap_of(item("t_a", remaining=1)))
+    store.append_delta(snap_of(item("t_b", remaining=2)))
+    store.close()
+    path = tmp_path / _delta_name(1)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 5)
+
+    inst = await V1Instance.create(_iconf(tmp_path, snapshot_interval=60))
+    try:
+        assert inst.restore_stats["corrupt_records"] == 1
+        assert inst.metrics.sample(
+            "gubernator_tpu_snapshot_corrupt_records_total") == 1
+        out = await inst.get_rate_limits([RateLimitRequest(
+            name="t", unique_key="a", hits=0, limit=100, duration=3_600_000,
+        )])
+        assert out[0].remaining == 1   # prefix state is live
+    finally:
+        await inst.close()
+
+
+class _StubEngine:
+    """No device work: this test exercises only peer bookkeeping."""
+
+    def cache_size(self):
+        return 0
+
+    def close(self):
+        pass
+
+
+async def test_set_peers_tracks_doomed_peer_shutdowns(tmp_path, caplog):
+    from gubernator_tpu.types import PeerInfo
+
+    inst = V1Instance(
+        InstanceConfig(cache_size=256, tpu_platform="cpu"),
+        engine=_StubEngine(),
+    )
+    try:
+        a = PeerInfo(grpc_address="127.0.0.1:1", is_owner=True)
+        b = PeerInfo(grpc_address="127.0.0.1:2")
+        inst.conf.advertise_address = "127.0.0.1:1"
+        inst.set_peers([a, b])
+        doomed = inst.local_picker.get_by_address("127.0.0.1:2")
+
+        async def boom():
+            raise RuntimeError("teardown exploded")
+
+        doomed.shutdown = boom
+        inst.set_peers([a])  # b removed -> tracked shutdown task
+        assert inst._peer_shutdown_tasks
+        import logging
+        with caplog.at_level(logging.WARNING, logger="gubernator.instance"):
+            await inst.close()
+        # The failure was logged, not swallowed; nothing left pending.
+        assert any("shutdown of removed peer" in r.message
+                   for r in caplog.records)
+        assert not inst._peer_shutdown_tasks
+        assert not inst._transfer_tasks
+    finally:
+        await inst.close()
+
+
+async def test_ownership_handoff_and_close_drain(tmp_path):
+    """One 3-daemon cluster, two acceptance behaviors:
+
+    (1) set_peers ring swap — owned GLOBAL keys whose new owner is a
+    different peer get their accumulated state pushed there; the key
+    keeps counting, no reset (ownership_transfer_loss == 0).
+    (2) graceful drain — hits still buffered at close() land on the
+    owner instead of dying with the process (bounded by drain_timeout).
+    The 60s sync window guarantees only the handoff push / close-path
+    drain can have delivered anything."""
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.types import Behavior
+
+    c = await Cluster.start(3, behaviors=BehaviorConfig(
+        global_sync_wait=60.0, batch_wait=0.001))
+    try:
+        name, key = "xfer", "xk"
+
+        def greq(hits):
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=1000,
+                duration=3_600_000, behavior=Behavior.GLOBAL,
+            )
+
+        owner = c.find_owning_daemon(name, key)
+        oi = c.daemons.index(owner)
+        sent = 9
+        oc = owner.client()
+        for _ in range(sent):
+            out = await oc.get_rate_limits([greq(1)])
+            assert out[0].error == ""
+        await oc.close()
+        assert owner.instance.global_mgr._owned  # tracked as owned
+
+        # Ring swap: drop the owner from everyone's peer list (it stays
+        # alive — a scale-down/ring-churn event, not a crash).
+        new_peers = [p for p in c.peers
+                     if p.grpc_address != owner.conf.grpc_listen_address]
+        for d in c.daemons:
+            d.set_peers(new_peers)
+
+        new_owner = owner.instance.get_peer(f"{name}_{key}")
+        assert new_owner is not None and not new_owner.info.is_owner
+
+        await c.wait_for_metric(
+            oi, "gubernator_tpu_ownership_transfers_total",
+            labels={"result": "pushed"}, timeout=10,
+        )
+
+        # The new owner answers from the transferred level — no reset.
+        nd = next(d for d in c.daemons
+                  if d.conf.grpc_listen_address
+                  == new_owner.info.grpc_address)
+        nc = nd.client()
+        r = (await nc.get_rate_limits([greq(0)]))[0]
+        assert 1000 - r.remaining == sent          # transfer loss == 0
+
+        # (2) Buffer hits on the OLD owner (now a non-owner for the
+        # key) against the new owner; only its graceful close can
+        # deliver them inside the 60s sync window.
+        oc2 = owner.client()
+        for _ in range(4):
+            out = await oc2.get_rate_limits([greq(1)])
+            assert out[0].error == ""
+        await oc2.close()
+        assert owner.instance.global_mgr._hits     # still buffered
+        await owner.close()                        # graceful drain
+
+        r = (await nc.get_rate_limits([greq(0)]))[0]
+        await nc.close()
+        assert 1000 - r.remaining == sent + 4      # drain lost nothing
+    finally:
+        await c.stop()
+
+
+async def test_readyz_and_healthcheck_ready_probe(tmp_path, monkeypatch,
+                                                  capsys):
+    """/readyz splits readiness from /healthz liveness, and the probe
+    binary's --ready flag follows it (one daemon serves both checks)."""
+    import aiohttp
+
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.cmd import healthcheck
+
+    c = await Cluster.start(1, http_gateway=True)
+    try:
+        d = c.daemons[0]
+        addr = d.conf.http_listen_address
+        monkeypatch.setenv("GUBER_HTTP_ADDRESS", addr)
+        monkeypatch.delenv("GUBER_STATUS_HTTP_ADDRESS", raising=False)
+        loop = asyncio.get_running_loop()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{addr}/readyz") as resp:
+                assert resp.status == 200
+                assert (await resp.json())["ready"] is True
+            assert await loop.run_in_executor(
+                None, healthcheck.main, ["--ready"]) == 0
+            # Drain: readiness drops to 503 while liveness stays 200.
+            d._draining = True
+            async with s.get(f"http://{addr}/readyz") as resp:
+                assert resp.status == 503
+                assert (await resp.json())["draining"] is True
+            async with s.get(f"http://{addr}/healthz") as resp:
+                assert resp.status == 200
+            assert await loop.run_in_executor(
+                None, healthcheck.main, ["--ready"]) == 2
+            assert "draining" in capsys.readouterr().err
+            d._draining = False
+    finally:
+        await c.stop()
