@@ -16,51 +16,16 @@ A from-scratch re-design of the capabilities of Gubernator
 * The gRPC/HTTP API surface, consistent-hash peering, behaviors, config
   and observability match the reference's wire contract.
 
-64-bit mode is required: the wire contract is int64 milliseconds /
-int64 hits-limits, and leaky-bucket remaining is float64.
+Importing this package does NOT import jax: the device bootstrap (x64
+mode + compile cache, required before any device use) lives in
+:mod:`gubernator_tpu.jaxinit`, which every jax-using module imports
+before ``import jax``.  That keeps device-free entry points — the
+container healthcheck probe, config parsing, and the static-analysis
+CLI (``python -m gubernator_tpu.analysis``) — free of the multi-second
+jax import and of the toolchain dependency entirely.
 """
 
-import os
-
-import jax
-
-jax.config.update("jax_enable_x64", True)
-
-def configure_compile_cache(environ=None) -> None:
-    """Persistent XLA compilation cache, on by default: tick-program
-    compiles cost tens of seconds on TPU toolchains and recur on every
-    daemon restart otherwise (measured 30s -> 8.5s cold start cached).
-
-    ``GUBER_COMPILE_CACHE_DIR=off`` disables; any other value overrides
-    the location; an explicit ``JAX_COMPILATION_CACHE_DIR`` always wins.
-    Runs at import AND again from ``setup_daemon_config`` so the knob
-    also works from a ``-config`` file (which loads into the environment
-    after import)."""
-    env = os.environ if environ is None else environ
-    cache_dir = env.get("GUBER_COMPILE_CACHE_DIR", "")
-    if cache_dir.lower() in ("off", "0", "false"):
-        jax.config.update("jax_compilation_cache_dir", None)
-        return
-    if env.get("JAX_COMPILATION_CACHE_DIR"):
-        # jax bound this option at import time; a -config file loads the
-        # env var after import, so re-apply it explicitly.
-        jax.config.update(
-            "jax_compilation_cache_dir", env["JAX_COMPILATION_CACHE_DIR"]
-        )
-        return
-    cache_dir = cache_dir or os.path.join(
-        os.path.expanduser("~"), ".cache", "gubernator-tpu", "xla"
-    )
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except OSError:  # unwritable home: run uncached
-        pass
-
-
-configure_compile_cache()
-
-from gubernator_tpu.types import (  # noqa: E402
+from gubernator_tpu.types import (
     Algorithm,
     Behavior,
     Status,
@@ -70,11 +35,22 @@ from gubernator_tpu.types import (  # noqa: E402
 
 from gubernator_tpu.version import VERSION as __version__
 
+
+def configure_compile_cache(environ=None) -> None:
+    """Re-apply the compile-cache knob (see jaxinit.configure_compile_cache;
+    kept here because setup_daemon_config and operator code call it via the
+    package root).  Imports jax — only call on a device-serving path."""
+    from gubernator_tpu import jaxinit
+
+    jaxinit.configure_compile_cache(environ)
+
+
 __all__ = [
     "Algorithm",
     "Behavior",
     "Status",
     "RateLimitRequest",
     "RateLimitResponse",
+    "configure_compile_cache",
     "__version__",
 ]

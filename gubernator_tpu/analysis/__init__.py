@@ -1,0 +1,49 @@
+"""guberlint — the project's AST-based invariant checker.
+
+Six bug classes this repo has already shipped (and hand-fixed, one PR at
+a time) are statically detectable properties of the source tree.  This
+package locks them down:
+
+==== =============================================================
+G001 device-sync primitive inside a ``@hot_path`` serving function
+G002 blocking call in ``async def`` / ``await`` under a held lock
+G003 fire-and-forget asyncio task (handle discarded)
+G004 ``GUBER_*`` env read outside the config registry / undocumented
+G005 Prometheus metric names drifting from ``docs/prometheus.md``
+G006 impure host calls inside jit/shard_map-traced functions
+==== =============================================================
+
+Pure stdlib on purpose: ``python -m gubernator_tpu.analysis`` and the
+tier-1 test that wraps it never import jax (or any third-party module),
+so the gate runs anywhere in well under a second.
+
+Suppression: ``# guber: allow-G003(reason)`` on the finding's line or
+the line above.  The reason is mandatory — an empty one leaves the
+finding live.  Grandfathered findings live in a checked-in baseline
+(``.guberlint-baseline.json``); see docs/static-analysis.md.
+"""
+
+from gubernator_tpu.analysis.core import (
+    Finding,
+    LintResult,
+    Project,
+    Rule,
+    RULES,
+    load_baseline,
+    load_project,
+    run_project,
+    write_baseline,
+)
+from gubernator_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Project",
+    "Rule",
+    "RULES",
+    "load_baseline",
+    "load_project",
+    "run_project",
+    "write_baseline",
+]

@@ -1,0 +1,112 @@
+"""``python -m gubernator_tpu.analysis`` — run guberlint over the repo.
+
+Exit status: 0 when every finding is suppressed (inline allow-comment)
+or baselined; 1 when any live finding remains; 2 on usage errors.
+
+Usage:
+    python -m gubernator_tpu.analysis [--root DIR] [--package NAME]
+        [--baseline PATH | --no-baseline] [--update-baseline]
+        [--rules G001,G004] [--json] [--list-rules] [-q]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from gubernator_tpu.analysis.core import (
+    BASELINE_NAME,
+    RULES,
+    load_baseline,
+    load_project,
+    run_project,
+    write_baseline,
+)
+from gubernator_tpu.analysis import rules as _rules  # noqa: F401
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gubernator_tpu.analysis",
+        description="guberlint: AST-based project invariant checker",
+    )
+    ap.add_argument("--root", default=None,
+                    help="project root (default: auto-detected repo root)")
+    ap.add_argument("--package", default="gubernator_tpu")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current live findings as the new baseline "
+                         "(then hand-edit the reason fields)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{rid}  {r.title}\n      {r.description}")
+        return 0
+
+    root = args.root
+    if root is None:
+        # The package dir's parent is the repo root when run in-tree.
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        root = here if os.path.isdir(
+            os.path.join(here, args.package)) else os.getcwd()
+    if not os.path.isdir(os.path.join(root, args.package)):
+        print(f"error: no package {args.package!r} under {root}",
+              file=sys.stderr)
+        return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",") if r]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"error: unknown rule(s) {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    project = load_project(root, args.package)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+
+    result = run_project(project, baseline, rule_ids)
+
+    if args.update_baseline:
+        write_baseline(baseline_path, project, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path} — edit each 'reason' to a real "
+              "justification (or fix the code)")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in result.findings],
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        if not args.quiet:
+            print(
+                f"guberlint: {len(result.findings)} finding(s), "
+                f"{result.suppressed} suppressed, "
+                f"{result.baselined} baselined",
+                file=sys.stderr,
+            )
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

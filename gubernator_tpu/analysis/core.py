@@ -1,0 +1,277 @@
+"""guberlint framework: findings, rules, suppressions, baseline.
+
+The shape is deliberately small: a :class:`Project` is every ``*.py``
+file of one package parsed once (AST + real comment tokens), a
+:class:`Rule` is a callable over the project returning :class:`Finding`
+rows, and :func:`run_project` subtracts inline suppressions and the
+checked-in baseline from the union of all rule output.  Everything is
+stdlib — rules must never import the modules they inspect (the linter
+has to run on hosts with no jax toolchain, and importing the serving
+code would drag the device stack in).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# ``# guber: allow-G001(reason)`` — the reason is part of the syntax, not
+# decoration: a suppression with an empty reason does not suppress.
+SUPPRESS_RE = re.compile(r"#\s*guber:\s*allow-(G\d{3})\(([^()]*)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          # "G001".."G006"
+    path: str          # project-root-relative, posix separators
+    line: int          # 1-indexed
+    message: str
+    fix_hint: str = ""
+
+    def fingerprint(self, source_line: str = "") -> str:
+        """Line-drift-tolerant identity for baseline matching: the rule,
+        the file, and the stripped text of the offending line — NOT the
+        line number, so unrelated edits above don't invalidate the
+        baseline."""
+        h = hashlib.sha1()
+        h.update(self.rule.encode())
+        h.update(b"|")
+        h.update(self.path.encode())
+        h.update(b"|")
+        h.update(source_line.strip().encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.fix_hint:
+            out += f"\n    fix: {self.fix_hint}"
+        return out
+
+
+class SourceFile:
+    """One parsed python file: text, AST, and real comment suppressions."""
+
+    def __init__(self, relpath: str, text: str):
+        self.path = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        # line -> [(rule, reason)] from actual COMMENT tokens (a string
+        # literal that merely contains the pattern must not suppress).
+        self.suppressions: Dict[int, List[Tuple[str, str]]] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                for m in SUPPRESS_RE.finditer(tok.string):
+                    self.suppressions.setdefault(tok.start[0], []).append(
+                        (m.group(1), m.group(2).strip())
+                    )
+        except (tokenize.TokenError, IndentationError):
+            pass
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        """True when an allow-comment with a NON-EMPTY reason for this
+        rule sits on the finding's line or the line directly above."""
+        for line in (finding.line, finding.line - 1):
+            for rule, reason in self.suppressions.get(line, []):
+                if rule == finding.rule and reason:
+                    return True
+        return False
+
+
+class Project:
+    """The lint unit: one package subtree under one project root."""
+
+    def __init__(self, root: str, package: str = "gubernator_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.files: List[SourceFile] = []
+        self.by_path: Dict[str, SourceFile] = {}
+
+    def add_file(self, relpath: str, text: str) -> SourceFile:
+        sf = SourceFile(relpath, text)
+        self.files.append(sf)
+        self.by_path[sf.path] = sf
+        return sf
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        """Non-python project file (example.conf, docs/*.md); None when
+        absent so rules can report the absence themselves."""
+        p = os.path.join(self.root, relpath)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # Well-known project paths rules key off (kept together so a repo
+    # re-layout is a one-place change).
+    @property
+    def config_path(self) -> str:
+        return f"{self.package}/config.py"
+
+    @property
+    def metrics_path(self) -> str:
+        return f"{self.package}/utils/metrics.py"
+
+    @property
+    def example_conf_path(self) -> str:
+        return "example.conf"
+
+    @property
+    def prometheus_doc_path(self) -> str:
+        return "docs/prometheus.md"
+
+
+def load_project(root: str, package: str = "gubernator_tpu") -> Project:
+    proj = Project(root, package)
+    pkg_dir = os.path.join(proj.root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, proj.root)
+            try:
+                with open(full, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            proj.add_file(rel, text)
+    return proj
+
+
+# ----------------------------------------------------------------------
+# Rule registry
+# ----------------------------------------------------------------------
+@dataclass
+class Rule:
+    id: str
+    title: str
+    description: str
+    fix_hint: str
+    check: Callable[[Project], Iterable[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    RULES[rule.id] = rule
+    return rule
+
+
+# ----------------------------------------------------------------------
+# Baseline: grandfathered findings, checked in, reason-annotated
+# ----------------------------------------------------------------------
+BASELINE_NAME = ".guberlint-baseline.json"
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """fingerprint-keyed allowance counts.  Key: (rule, path, fp)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    out: Dict[Tuple[str, str, str], int] = {}
+    for row in data.get("findings", []):
+        key = (row["rule"], row["path"], row["fingerprint"])
+        out[key] = out.get(key, 0) + int(row.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, project: Project,
+                   findings: List[Finding]) -> None:
+    """Write the given (still-unsuppressed) findings as the new baseline.
+    Every entry carries a reason field the operator is expected to edit —
+    'grandfathered' is a placeholder, not a justification."""
+    rows = []
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        sf = project.by_path.get(f.path)
+        fp = f.fingerprint(sf.line_text(f.line) if sf else "")
+        key = (f.rule, f.path, fp)
+        if key in counts:
+            counts[key] += 1
+            continue
+        counts[key] = 1
+        rows.append({
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "fingerprint": fp, "message": f.message,
+            "reason": "grandfathered — justify or fix",
+        })
+    for row in rows:
+        key = (row["rule"], row["path"], row["fingerprint"])
+        if counts[key] > 1:
+            row["count"] = counts[key]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": rows}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ----------------------------------------------------------------------
+# Runner
+# ----------------------------------------------------------------------
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # live
+    suppressed: int = 0
+    baselined: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run_project(
+    project: Project,
+    baseline: Optional[Dict[Tuple[str, str, str], int]] = None,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> LintResult:
+    result = LintResult()
+    remaining = dict(baseline or {})
+    ids = sorted(rule_ids) if rule_ids else sorted(RULES)
+    all_findings: List[Finding] = []
+    for rid in ids:
+        all_findings.extend(RULES[rid].check(project))
+    all_findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    for f in all_findings:
+        sf = project.by_path.get(f.path)
+        if sf is not None and sf.suppressed(f):
+            result.suppressed += 1
+            continue
+        fp = f.fingerprint(sf.line_text(f.line) if sf else "")
+        key = (f.rule, f.path, fp)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            result.baselined += 1
+            continue
+        result.findings.append(f)
+    return result

@@ -16,7 +16,7 @@ import random
 import secrets
 import string
 import time
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 from gubernator_tpu.types import PeerInfo
 from gubernator_tpu.utils import timeutil

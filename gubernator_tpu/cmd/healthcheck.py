@@ -13,10 +13,11 @@ only to nodes that want it (docs/persistence.md).
 from __future__ import annotations
 
 import json
-import os
 import sys
 import urllib.error
 import urllib.request
+
+from gubernator_tpu.config import env_knob
 
 
 def main(argv=None) -> int:
@@ -29,7 +30,9 @@ def main(argv=None) -> int:
     # Prefer the no-mTLS status listener when configured: under
     # GUBER_TLS_CLIENT_AUTH the main gateway rejects cleartext probes,
     # which is exactly what GUBER_STATUS_HTTP_ADDRESS exists for.
-    addr = os.environ.get("GUBER_STATUS_HTTP_ADDRESS") or os.environ.get(
+    # Registry reads (config.env_knob) — no jax import rides along:
+    # the package root and config are device-free by design.
+    addr = env_knob("GUBER_STATUS_HTTP_ADDRESS") or env_knob(
         "GUBER_HTTP_ADDRESS", "localhost:80"
     )
     path = "/readyz" if ready_probe else "/v1/HealthCheck"

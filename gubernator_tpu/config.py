@@ -34,6 +34,132 @@ log = logging.getLogger("gubernator")
 DISCOVERY_TYPES = ("member-list", "etcd", "dns", "k8s", "none")
 
 
+# ----------------------------------------------------------------------
+# The env-var registry: THE single source of truth for the supported
+# ``GUBER_*`` surface.  guberlint rule G004 (gubernator_tpu/analysis)
+# enforces that every GUBER_* name mentioned anywhere in the package is
+# a key here, that every key is documented in example.conf (and vice
+# versa), and that no module reads os.environ for a GUBER_* knob
+# directly — module-level fast-path reads go through :func:`env_knob`.
+# ----------------------------------------------------------------------
+ENV_REGISTRY: Dict[str, str] = {
+    "GUBER_ADVERTISE_ADDRESS": "address peers use to reach this node",
+    "GUBER_BATCH_LIMIT": "max requests per forwarded peer batch",
+    "GUBER_BATCH_TIMEOUT": "deadline for a forwarded peer batch",
+    "GUBER_BATCH_WAIT": "batch accumulation window (the tick wait)",
+    "GUBER_BREAKER_ENABLED": "per-peer circuit breakers on/off",
+    "GUBER_BREAKER_FAILURE_THRESHOLD": "failure fraction that opens a breaker",
+    "GUBER_BREAKER_HALF_OPEN_PROBES": "probe RPCs allowed half-open",
+    "GUBER_BREAKER_MIN_REQUESTS": "min window samples before tripping",
+    "GUBER_BREAKER_OPEN_CAP": "max open duration (backoff cap)",
+    "GUBER_BREAKER_OPEN_FOR": "initial open duration",
+    "GUBER_BREAKER_WINDOW": "sliding failure window length",
+    "GUBER_CACHE_SIZE": "device bucket-table capacity (slots)",
+    "GUBER_COLD_CACHE_SIZE": "host-side cold-tier entry budget (0 = off)",
+    "GUBER_COMPILE_CACHE_DIR": "persistent XLA compile cache dir / 'off'",
+    "GUBER_DATA_CENTER": "datacenter name for region-aware picking",
+    "GUBER_DISABLE_BATCHING": "disable peer-forwarding batches",
+    "GUBER_DNS_FQDN": "dns discovery: name to resolve for peers",
+    "GUBER_DRAIN_TIMEOUT": "graceful-shutdown GLOBAL flush budget",
+    "GUBER_ETCD_DIAL_TIMEOUT": "etcd discovery: dial timeout",
+    "GUBER_ETCD_ENDPOINTS": "etcd discovery: endpoints (comma list)",
+    "GUBER_ETCD_KEY_PREFIX": "etcd discovery: peer key prefix",
+    "GUBER_ETCD_PASSWORD": "etcd discovery: password",
+    "GUBER_ETCD_USER": "etcd discovery: username",
+    "GUBER_FAULT_DELAY": "fault injection: added per-RPC latency",
+    "GUBER_FAULT_DROP_RATE": "fault injection: DEADLINE_EXCEEDED rate",
+    "GUBER_FAULT_ERROR_RATE": "fault injection: UNAVAILABLE rate",
+    "GUBER_FAULT_PARTITION": "fault injection: 100% UNAVAILABLE",
+    "GUBER_FAULT_PEERS": "fault injection: target peers or '*'",
+    "GUBER_FAULT_SEED": "fault injection: RNG seed",
+    "GUBER_FORCE_GLOBAL": "force GLOBAL behavior on every request",
+    "GUBER_FORWARD_BACKOFF_BASE": "forward-retry backoff base",
+    "GUBER_FORWARD_BACKOFF_CAP": "forward-retry backoff cap",
+    "GUBER_FORWARD_MAX_ATTEMPTS": "forward-retry attempt budget",
+    "GUBER_GLOBAL_BATCH_LIMIT": "max records per GLOBAL flush batch",
+    "GUBER_GLOBAL_SYNC_WAIT": "GLOBAL reconcile cadence",
+    "GUBER_GLOBAL_TIMEOUT": "deadline for GLOBAL RPCs",
+    "GUBER_GRPC_ADDRESS": "gRPC listen address",
+    "GUBER_GRPC_MAX_CONN_AGE_SEC": "max gRPC client connection age (0 = inf)",
+    "GUBER_HTTP_ADDRESS": "HTTP/JSON gateway listen address",
+    "GUBER_INSTANCE_ID": "unique instance id for logs/tracing",
+    "GUBER_K8S_ENDPOINTS_SELECTOR": "k8s discovery: endpoints selector",
+    "GUBER_K8S_NAMESPACE": "k8s discovery: namespace",
+    "GUBER_K8S_POD_IP": "k8s discovery: this pod's IP",
+    "GUBER_K8S_POD_PORT": "k8s discovery: this pod's port",
+    "GUBER_K8S_WATCH_MECHANISM": "k8s discovery: 'endpoints' or 'pods'",
+    "GUBER_LOG_FORMAT": "log format: text or json",
+    "GUBER_LOG_LEVEL": "log level: debug/info/warning/error",
+    "GUBER_MEMBERLIST_ADDRESS": "member-list discovery: bind address",
+    "GUBER_MEMBERLIST_ADVERTISE_ADDRESS": "member-list: advertise address",
+    "GUBER_MEMBERLIST_KNOWN_NODES": "member-list: seed nodes (comma list)",
+    "GUBER_METRIC_FLAGS": "optional collectors: os,golang",
+    "GUBER_PEER_DISCOVERY_TYPE": "discovery pool: member-list/etcd/dns/k8s/none",
+    "GUBER_PEER_PICKER": "peer picker implementation",
+    "GUBER_PEER_PICKER_HASH": "picker hash: fnv1 or fnv1a",
+    "GUBER_REDELIVERY_LIMIT": "GLOBAL redelivery buffer cap",
+    "GUBER_REPLICATED_HASH_REPLICAS": "consistent-hash virtual replicas",
+    "GUBER_RESOLV_CONF": "dns discovery: resolv.conf path",
+    "GUBER_SNAPSHOT_DELTAS_PER_BASE": "delta records per base compaction",
+    "GUBER_SNAPSHOT_DIR": "crash-safe snapshot directory ('' = off)",
+    "GUBER_SNAPSHOT_INTERVAL": "delta snapshot cadence (seconds)",
+    "GUBER_STATUS_HTTP_ADDRESS": "no-mTLS health/metrics listener",
+    "GUBER_TICK_PIPELINE_DEPTH": "dispatched-unresolved tick windows in flight",
+    "GUBER_TLS_AUTO": "self-signed server TLS",
+    "GUBER_TLS_CA": "TLS CA cert file",
+    "GUBER_TLS_CA_KEY": "TLS CA key file (auto-signs server certs)",
+    "GUBER_TLS_CERT": "TLS server cert file",
+    "GUBER_TLS_CLIENT_AUTH": "client-cert policy for mTLS",
+    "GUBER_TLS_CLIENT_AUTH_CA_CERT": "CA bundle validating client certs",
+    "GUBER_TLS_CLIENT_AUTH_CERT": "client cert for peer dials",
+    "GUBER_TLS_CLIENT_AUTH_KEY": "client key for peer dials",
+    "GUBER_TLS_CLIENT_AUTH_SERVER_NAME": "expected server name on dials",
+    "GUBER_TLS_INSECURE_SKIP_VERIFY": "skip peer cert verification (dev only)",
+    "GUBER_TLS_KEY": "TLS server key file",
+    "GUBER_TLS_MIN_VERSION": "minimum TLS version",
+    "GUBER_TPU_BG_RECLAIM": "background reclaim: auto/on/off",
+    "GUBER_TPU_DMA_RING": "row-kernel DMA ring slots (pow2)",
+    "GUBER_TPU_DMA_UNROLL": "row-kernel DMA issue unroll (pow2)",
+    "GUBER_TPU_FUSED_TICK": "force fused Pallas tick on/off (default: auto)",
+    "GUBER_TPU_GLOBAL_MESH_CAPACITY": "GLOBAL mesh slot capacity",
+    "GUBER_TPU_GLOBAL_MESH_NODE": "this node's mesh index (-1 = auto)",
+    "GUBER_TPU_GLOBAL_MESH_NODES": "GLOBAL mesh size (0 = gRPC loops only)",
+    "GUBER_TPU_MAX_BATCH": "request columns per device tick",
+    "GUBER_TPU_MESH_SHARDS": "table shards on the device mesh",
+    "GUBER_TPU_PLATFORM": "force jax platform (e.g. cpu)",
+    "GUBER_TPU_SORTED32": "0 = x64 oracle tick for duplicate batches",
+    "GUBER_TPU_TABLE_LAYOUT": "bucket-table layout: auto/columns/row",
+}
+
+
+def env_knob(name: str, default=None, parse: Optional[Callable] = None,
+             environ: Optional[Dict[str, str]] = None):
+    """Registered read of one ``GUBER_*`` knob from the environment.
+
+    The blessed accessor for module-level fast-path reads outside
+    :func:`setup_daemon_config` (feature toggles resolved at engine
+    construction, the healthcheck probe's listener address): the name
+    must be a key of :data:`ENV_REGISTRY` — an unregistered read raises
+    at import/construction time instead of silently growing the env
+    surface — and ``parse`` failures carry the var name.  Unset or
+    empty returns ``default`` unparsed."""
+    if name not in ENV_REGISTRY:
+        raise KeyError(
+            f"{name} is not registered in config.ENV_REGISTRY; add it "
+            "there (and to example.conf) first"
+        )
+    env = os.environ if environ is None else environ
+    v = env.get(name, "")
+    if v == "":
+        return default
+    if parse is None:
+        return v
+    try:
+        return parse(v)
+    except ValueError as e:
+        raise ValueError(f"{name}: {e}") from None
+
+
 def _ms(v: float) -> float:
     return v / 1000.0
 

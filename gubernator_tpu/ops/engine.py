@@ -29,6 +29,7 @@ import functools
 import threading
 from typing import Dict, List, Optional, Sequence
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,11 +40,9 @@ from gubernator_tpu.ops.buckets import (
     ReqBatch,
     RespBatch,
     bucket_transition,
-    gather_field,
     gather_state,
     np_logical,
     to_logical,
-    scatter_field,
     scatter_state,
 )
 from gubernator_tpu.ops import rowtable
@@ -59,6 +58,7 @@ from gubernator_tpu.types import (
     has_behavior,
 )
 from gubernator_tpu.utils import timeutil, tracing
+from gubernator_tpu.utils.hotpath import hot_path
 
 
 # Table storage layouts (see rowtable.py for the row design rationale):
@@ -2006,8 +2006,9 @@ class TickEngine:
         # per-slot order, ceil(units/8) gather+scatter rounds, no XLA
         # 64-bit emulation.  GUBER_TPU_SORTED32=0 falls back to the x64
         # oracle program (engine.make_tick_fn), which stays the parity
-        # reference in tests.
-        import os as _os
+        # reference in tests.  Registry read, once per engine — never
+        # per tick.
+        from gubernator_tpu.config import env_knob
 
         from gubernator_tpu.ops.tick32 import (
             jitted_merged_pipeline,
@@ -2015,7 +2016,7 @@ class TickEngine:
             jitted_tick32,
         )
 
-        if _os.environ.get("GUBER_TPU_SORTED32") == "0":
+        if env_knob("GUBER_TPU_SORTED32") == "0":
             self._tick = _jitted_tick(self.capacity, self.layout,
                                       sorted_input=True, compact_resp=True,
                                       compact_req=True)
@@ -2408,6 +2409,7 @@ class TickEngine:
         if t is not None:
             t.join(timeout=5)
 
+    @hot_path
     def _build_cols(self, cols: ReqColumns, now: int):
         """Resolve keys to slots and pack the padded (12, B) request matrix
         from a columnar batch — zero per-request Python on the no-error,
@@ -2451,6 +2453,7 @@ class TickEngine:
         # per-key map lookup inside each worker goroutine; here it's a batch
         # against the C++ open-addressing table, fed the key blob directly).
         if errors:
+            # guber: allow-G001(builds a host index list, never device)
             sel = np.array([i for i in range(n) if i not in errors], np.int64)
             if len(sel) == 0:
                 return m, n, errors, np.arange(n, dtype=np.int64), False
@@ -2499,6 +2502,7 @@ class TickEngine:
                 sel = (
                     np.flatnonzero(keep)
                     if sel is None
+                    # guber: allow-G001(sel is host numpy, never device)
                     else np.asarray(sel)[keep]
                 ).astype(np.int64)
                 slots = slots[keep]
@@ -2563,11 +2567,12 @@ class TickEngine:
         # the parts-native program (no 64-bit ops, Mosaic-compilable),
         # duplicate-bearing ones to the merge-capable program.
         sl = m[R["slot"], :n]
-        has_dups = bool(
+        has_dups = bool(  # guber: allow-G001(m is host numpy, never device)
             ((sl[1:] == sl[:-1]) & (sl[1:] < self.capacity)).any()
         )
         return m, n, errors, inv, has_dups
 
+    @hot_path
     def _promote_misses(
         self, cols: ReqColumns, sel, slots, known, miss, now: int
     ) -> np.ndarray:
@@ -2583,6 +2588,7 @@ class TickEngine:
         map marks later occurrences known), so hit rows map to unique
         slots and the single scatter has no write conflicts."""
         midx = np.flatnonzero(miss)
+        # guber: allow-G001(sel is host numpy, never device)
         src = midx if sel is None else np.asarray(sel)[midx]
         pos, ccols = self.cold.take(
             [cols.key_bytes(int(j)) for j in src], now
@@ -2656,6 +2662,7 @@ class TickEngine:
     # ------------------------------------------------------------------
     # The tick
     # ------------------------------------------------------------------
+    @hot_path
     def submit_columns(
         self, cols: ReqColumns, now: Optional[int] = None
     ) -> "TickHandle":
@@ -2774,6 +2781,7 @@ class TickEngine:
                 handle.result()
             return handle
 
+    @hot_path
     def submit_cols(
         self, cols: ReqColumns, now: Optional[int] = None
     ) -> SubmittedBatch:
@@ -2804,6 +2812,7 @@ class TickEngine:
             return np.zeros((5, 0), np.int64), {}
         return self.submit_cols(cols, now).matrix()
 
+    @hot_path
     def submit(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ) -> SubmittedBatch:

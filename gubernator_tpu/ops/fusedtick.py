@@ -36,13 +36,13 @@ from __future__ import annotations
 
 import functools
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gubernator_tpu.ops import i64pair as p64
 from gubernator_tpu.ops.engine import REQ32_INDEX, REQ32_ROWS
 from gubernator_tpu.ops.i64pair import I64
 from gubernator_tpu.ops.rowtable import ROW_W, _interpret

@@ -45,6 +45,7 @@ import logging
 import os
 from typing import NamedTuple
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 
@@ -31,7 +32,6 @@ from gubernator_tpu.ops import i64pair as p64
 from gubernator_tpu.types import Behavior
 from gubernator_tpu.ops.transition32 import (
     preq_from_compact,
-    presp_to_compact,
     pstate_from_matrix,
     pstate_gather_columns,
     pstate_scatter_columns,
@@ -59,12 +59,14 @@ def _resolve_fused(fused: bool | None) -> bool:
     loop — seconds per tick), so the 8-device test mesh would crawl;
     GUBER_TPU_FUSED_TICK=0/1 still forces either path on any backend
     (tests/test_fusedtick.py covers fused-vs-unfused parity in interpret
-    mode explicitly)."""
-    import os
+    mode explicitly).  Read through the config registry at engine
+    construction (not per tick — the resolved choice is baked into the
+    jitted program cache key)."""
+    from gubernator_tpu.config import env_knob
 
     if fused is not None:
         return fused
-    env = os.environ.get("GUBER_TPU_FUSED_TICK")
+    env = env_knob("GUBER_TPU_FUSED_TICK")
     if env is not None:
         return env != "0"
     return jax.default_backend() == "tpu"

@@ -78,6 +78,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -103,8 +104,6 @@ from gubernator_tpu.ops.engine import (
     pack_request_matrix,
     _slot_segments,
     make_slot_map,
-    pack_resp,
-    pad_pow2,
     resolve_gregorian,
     unpack_reqs,
 )

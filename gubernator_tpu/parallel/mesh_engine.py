@@ -37,6 +37,7 @@ import threading
 import zlib
 from typing import Dict, List, Optional, Sequence
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,7 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gubernator_tpu.utils.jaxcompat import shard_map
 
 from gubernator_tpu.ops import rowtable
-from gubernator_tpu.ops.buckets import BucketState, np_logical, slice_field
+from gubernator_tpu.ops.buckets import BucketState, slice_field
 from gubernator_tpu.ops.engine import (
     EVICT_CHUNK,
     ITEM_INT_ROWS,
@@ -53,7 +54,6 @@ from gubernator_tpu.ops.engine import (
     REQ32_INDEX,
     REQ32_ROWS,
     RESTORE_CHUNK,
-    SNAP_FIELDS,
     device_dead_mask,
     items_from_columns,
     join_i32_pair,
@@ -72,6 +72,7 @@ from gubernator_tpu.ops.rowtable import ROW_W, RowState
 from gubernator_tpu.types import (
     Behavior, GlobalUpdate, RateLimitRequest, RateLimitResponse)
 from gubernator_tpu.utils import timeutil
+from gubernator_tpu.utils.hotpath import hot_path
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -453,6 +454,7 @@ class MeshTickEngine:
     # The tick — columnar, pipelined (the round-3 TickEngine host path,
     # uniform across however many shards exist: workers.go:125-147)
     # ------------------------------------------------------------------
+    @hot_path
     def submit_columns(
         self, cols, now: Optional[int] = None
     ) -> "MeshTickHandle":
@@ -503,6 +505,7 @@ class MeshTickEngine:
             # Per-shard native resolve: regroup the key blob by shard
             # with one byte-gather, then one resolve_blob per shard.
             order = np.argsort(sh, kind="stable")
+            # guber: allow-G001(key_offsets is host numpy, never device)
             offs = np.asarray(cols.key_offsets, np.int64)
             lens = np.diff(offs)
             lo = lens[order]
@@ -628,6 +631,7 @@ class MeshTickEngine:
             # x64 program wholesale (cross-member sequencing).
             key_sorted = key[order2]
             slots_sorted = safe_slots[order2]
+            # guber: allow-G001(sort keys are host numpy, never device)
             has_dups = bool(np.any(
                 (key_sorted[1:] == key_sorted[:-1])
                 & (slots_sorted[1:] < self.local_capacity)
@@ -652,6 +656,7 @@ class MeshTickEngine:
                 handle.result()
             return handle
 
+    @hot_path
     def submit_cols(self, cols, now: Optional[int] = None):
         """Dispatch a columnar batch of any width (chunked into
         max_batch ticks; chunk k+1 packs while chunk k executes)."""
@@ -671,6 +676,7 @@ class MeshTickEngine:
         ]
         return SubmittedBatch(handles, spans, n)
 
+    @hot_path
     def submit(
         self, requests: Sequence[RateLimitRequest], now: Optional[int] = None
     ):

@@ -20,7 +20,7 @@ import asyncio
 import collections
 import random
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 import grpc

@@ -20,7 +20,7 @@ import asyncio
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import grpc
 
@@ -132,6 +132,7 @@ class InstanceConfig:
 
 
 def _make_engine(conf: InstanceConfig):
+    import gubernator_tpu.jaxinit  # noqa: F401  (x64 + cache before jax use)
     import jax
 
     if conf.tpu_platform:
@@ -230,6 +231,7 @@ class V1Instance:
                 # Env-configured mode: this node's identity on the mesh is
                 # its jax process index (multi-host meshes have one service
                 # process per host); -1 means exactly that auto-default.
+                import gubernator_tpu.jaxinit  # noqa: F401
                 import jax
 
                 conf.global_mesh_node = (
@@ -248,6 +250,12 @@ class V1Instance:
         # pending-task warnings).
         self._peer_shutdown_tasks: set = set()
         self._transfer_tasks: set = set()
+        # Per-item forward tasks (_async_request): the dispatch loop
+        # normally awaits each one, but an exception out of an EARLIER
+        # await in _get_rate_limits would abandon the rest mid-flight —
+        # tracked + done-callback-logged (the doomed-peer pattern) so no
+        # forward ever dies silently, and close() can await stragglers.
+        self._forward_tasks: set = set()
         # Crash-safe persistence (docs/persistence.md): wired by create().
         self._snapshot_writer = None
         self.restore_stats: dict = {}
@@ -412,9 +420,10 @@ class V1Instance:
                 ),
             )
 
-        # Forwarded items: per-item task with retry/ownership-reresolution.
+        # Forwarded items: per-item task with retry/ownership-reresolution,
+        # retained and supervised (G003): tracked set + logged exceptions.
         fwd_tasks = [
-            asyncio.ensure_future(self._async_request(peer, req, key))
+            self._spawn_forward(peer, req, key)
             for _, peer, req, key in forward
         ]
 
@@ -581,6 +590,31 @@ class V1Instance:
             max(0, eng.metric_reconcile_dispatches - before[0]))
         self.metrics.mesh_dense_fallbacks.inc(
             max(0, eng.metric_dense_fallbacks - before[1]))
+
+    def _spawn_forward(
+        self, peer: PeerClient, req: RateLimitRequest, key: str
+    ) -> "asyncio.Task":
+        """Spawn one supervised forward task (the doomed-peer pattern,
+        set_peers): handle retained in ``_forward_tasks`` and failures
+        logged on completion, so a forward abandoned by an exception
+        earlier in the dispatch loop is never GC'd mid-flight with a
+        swallowed error."""
+        t = asyncio.ensure_future(self._async_request(peer, req, key))
+        self._forward_tasks.add(t)
+
+        def _done(task: "asyncio.Task") -> None:
+            self._forward_tasks.discard(task)
+            if task.cancelled():
+                return
+            exc = task.exception()
+            if exc is not None:
+                self.log.warning(
+                    "forwarded request for %r failed: %s", key, exc,
+                    exc_info=exc,
+                )
+
+        t.add_done_callback(_done)
+        return t
 
     async def _async_request(
         self, peer: PeerClient, req: RateLimitRequest, key: str
@@ -925,6 +959,12 @@ class V1Instance:
                 await self._mesh_task
             except (asyncio.CancelledError, Exception):
                 pass
+        # Forward tasks abandoned by a failed dispatch loop would outlive
+        # the instance; their done-callbacks already log failures.
+        if self._forward_tasks:
+            await asyncio.gather(
+                *list(self._forward_tasks), return_exceptions=True
+            )
         # Earlier ring changes spawned doomed-peer shutdowns; await them
         # (each logs its own failure) so no task outlives the instance.
         if self._peer_shutdown_tasks:

@@ -30,7 +30,6 @@ import grpc
 import grpc.aio
 
 from gubernator_tpu.config import BehaviorConfig
-from gubernator_tpu.pb import gubernator_pb2 as pb
 from gubernator_tpu.pb import peers_pb2 as peers_pb
 from gubernator_tpu.resilience import (
     BreakerOpenError,

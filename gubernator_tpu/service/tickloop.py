@@ -23,11 +23,12 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+from gubernator_tpu.utils.hotpath import hot_path
 
 _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 
@@ -38,12 +39,14 @@ _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 # round trip (profiled: the serving path's CPU is ~3 ms/1000-item batch;
 # the round trip is what queues).  The bound is the backpressure: when
 # the device falls behind, dispatch blocks here instead of queueing
-# unbounded work.  GUBER_TICK_PIPELINE_DEPTH overrides.
-import os as _os
+# unbounded work.  GUBER_TICK_PIPELINE_DEPTH overrides — a registry
+# read (config.env_knob), cached here at import so the serving path
+# never touches the environment.
+from gubernator_tpu.config import env_knob
 
 try:
-    PIPELINE_DEPTH = max(1, int(_os.environ.get(
-        "GUBER_TICK_PIPELINE_DEPTH", "4")))
+    PIPELINE_DEPTH = max(1, env_knob(
+        "GUBER_TICK_PIPELINE_DEPTH", 4, parse=int))
 except ValueError:
     PIPELINE_DEPTH = 4
 
@@ -138,6 +141,7 @@ class TickLoop:
             self._cond.notify()
         return fut
 
+    @hot_path
     def _run(self) -> None:
         while True:
             with self._cond:
@@ -162,6 +166,7 @@ class TickLoop:
                 self._pending_count = 0
             self._flush(batch)
 
+    @hot_path
     def _flush(self, batch: List[tuple]) -> None:
         """Dispatch one window.  Object and columnar submissions each
         coalesce into (at most) one engine submission; both ride the same

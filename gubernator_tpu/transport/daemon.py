@@ -34,7 +34,7 @@ from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
 from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
 from gubernator_tpu.types import GlobalUpdate, PeerInfo
 from gubernator_tpu.utils import tracing
-from gubernator_tpu.utils.metrics import CONTENT_TYPE_LATEST, Metrics
+from gubernator_tpu.utils.metrics import Metrics
 
 log = logging.getLogger("gubernator.daemon")
 

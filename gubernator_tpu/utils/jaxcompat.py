@@ -9,6 +9,7 @@ source tree runs on both.
 
 from __future__ import annotations
 
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 
 _NEW = getattr(jax, "shard_map", None)

@@ -32,7 +32,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional
 
 TRACEPARENT = "traceparent"
 # W3C trace-context: version 00 is exactly 4 fields; a higher version may
@@ -390,6 +390,7 @@ def profile_annotation(name: str):
     """A ``jax.profiler.TraceAnnotation`` naming device work in XProf
     captures; degrades to a no-op when the profiler is unavailable."""
     try:
+        import gubernator_tpu.jaxinit  # noqa: F401  (x64 + cache before jax use)
         import jax.profiler
 
         return jax.profiler.TraceAnnotation(name)

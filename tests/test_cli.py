@@ -180,8 +180,11 @@ def test_daemon_main_boots_and_serves():
 
 
 def test_compile_cache_configured_by_default(tmp_path):
-    """The package enables the persistent XLA compile cache unless
-    disabled; daemon restarts must not re-pay tick compiles."""
+    """The device bootstrap (gubernator_tpu.jaxinit, imported by every
+    jax-using module) enables the persistent XLA compile cache unless
+    disabled; daemon restarts must not re-pay tick compiles.  The bare
+    package import stays jax-free by design — the probe imports the
+    bootstrap the way any device module does."""
 
     def cache_env(**extra):
         env = _env(HOME=str(tmp_path), **extra)
@@ -190,7 +193,7 @@ def test_compile_cache_configured_by_default(tmp_path):
 
     out = subprocess.run(
         [sys.executable, "-c",
-         "import jax, gubernator_tpu;"
+         "import jax, gubernator_tpu.jaxinit;"
          "print(jax.config.jax_compilation_cache_dir or '')"],
         env=cache_env(), capture_output=True, text=True, timeout=120,
     )
@@ -199,7 +202,7 @@ def test_compile_cache_configured_by_default(tmp_path):
 
     out = subprocess.run(
         [sys.executable, "-c",
-         "import jax, gubernator_tpu;"
+         "import jax, gubernator_tpu.jaxinit;"
          "print(repr(jax.config.jax_compilation_cache_dir))"],
         env=cache_env(GUBER_COMPILE_CACHE_DIR="off"),
         capture_output=True, text=True, timeout=120,
