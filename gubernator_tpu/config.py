@@ -82,6 +82,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_GRPC_ADDRESS": "gRPC listen address",
     "GUBER_GRPC_MAX_CONN_AGE_SEC": "max gRPC client connection age (0 = inf)",
     "GUBER_HTTP_ADDRESS": "HTTP/JSON gateway listen address",
+    "GUBER_INGEST_ARENA_SLABS": "preallocated wire-decode column slabs (0 = off)",
     "GUBER_INSTANCE_ID": "unique instance id for logs/tracing",
     "GUBER_K8S_ENDPOINTS_SELECTOR": "k8s discovery: endpoints selector",
     "GUBER_K8S_NAMESPACE": "k8s discovery: namespace",

@@ -23,12 +23,14 @@ Store hooks); :meth:`ReqColumns.from_requests` bridges.  The engine's
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from gubernator_tpu.types import RateLimitRequest
+from gubernator_tpu.utils.hotpath import hot_path
 
 # `created_at` sentinel: proto3 optional presence maps to "server stamps
 # now" (gubernator.proto:172-182).  0 is a legal (if silly) client value,
@@ -68,9 +70,23 @@ class ReqColumns:
     # fields from the packed key without re-splitting.  Optional: only
     # the transport paths that re-encode need it.
     name_len: Optional[np.ndarray] = None
+    # Arena-backed batches (fastwire.parse_req decoding into a
+    # ColumnArena slab) carry their lease here; the serving edge calls
+    # :meth:`release` once the tick has consumed the columns so the slab
+    # recycles.  Plain batches carry None and release() is a no-op.
+    lease: Optional["ArenaLease"] = field(default=None, repr=False)
 
     def __len__(self) -> int:
         return len(self.hits)
+
+    def release(self) -> None:
+        """Return the backing arena slab (idempotent; no-op when the
+        batch owns its arrays).  After release the column views may be
+        overwritten by a later window — callers release only once the
+        engine has packed the batch into its own request matrix."""
+        lease, self.lease = self.lease, None
+        if lease is not None:
+            lease.release()
 
     def key_bytes(self, j: int) -> bytes:
         o = self.key_offsets
@@ -201,3 +217,108 @@ def key_blob_from_parts(
     return pack_blob(
         [(nm + "_" + uk).encode() for nm, uk in zip(names, unique_keys)]
     )
+
+
+# ----------------------------------------------------------------------
+# Ingest column arena: preallocated per-window decode slabs
+# ----------------------------------------------------------------------
+class ArenaLease:
+    """One leased slab of a :class:`ColumnArena` (views handed to the
+    decoder plus the release token).  Thread-safe release; idempotent."""
+
+    __slots__ = ("arena", "index", "ints", "flags", "blob")
+
+    def __init__(self, arena: "ColumnArena", index: int,
+                 ints: np.ndarray, flags: np.ndarray, blob: np.ndarray):
+        self.arena = arena
+        self.index = index
+        self.ints = ints
+        self.flags = flags
+        self.blob = blob
+
+    def release(self) -> None:
+        arena, self.arena = self.arena, None
+        if arena is not None:
+            arena._release(self.index)
+
+
+class ColumnArena:
+    """Reusable, capacity-bounded decode slabs for the wire→columns edge.
+
+    The serving fast path (transport/fastwire.parse_req) used to
+    allocate a fresh ``(9, n+1)`` int64 block, a flags vector, and a
+    key-blob staging buffer per request batch — at serving batch rates
+    the allocator (and the page-zeroing behind ``np.zeros``) is a
+    measurable slice of the 0.15 ms/batch serve CPU.  The arena
+    preallocates ``slabs`` fixed-size buffer sets once and leases them
+    per window; a leased slab's numpy views become the
+    :class:`ReqColumns` columns directly (zero copies besides the key
+    blob's bytes materialization, which the native slotmap requires).
+
+    Bounded by construction: a batch wider than ``max_batch`` (or a key
+    blob larger than the slab), or a lease request while every slab is
+    busy (more concurrent in-flight windows than ``slabs``), returns
+    None and the caller falls back to plain allocation — the arena is a
+    fast path, never a correctness constraint.  ``slabs`` should cover
+    the tick pipeline depth plus decode concurrency
+    (GUBER_INGEST_ARENA_SLABS; see docs/tpu-performance.md).
+    """
+
+    # Key-blob staging bytes per request row.  parse_req needs
+    # len(data) + n staging bytes for a batch of n; hash keys in the
+    # wild run tens of bytes, and oversized batches just fall back.
+    BLOB_PER_ROW = 128
+
+    def __init__(self, max_batch: int, slabs: int = 8):
+        self.max_batch = int(max_batch)
+        self.n_slabs = max(1, int(slabs))
+        self.blob_cap = self.max_batch * self.BLOB_PER_ROW
+        self._ints = np.zeros(
+            (self.n_slabs, 9, self.max_batch + 1), np.int64)
+        self._flags = np.zeros((self.n_slabs, self.max_batch), np.uint8)
+        self._blob = np.empty((self.n_slabs, self.blob_cap), np.uint8)
+        self._busy = [False] * self.n_slabs
+        self._next = 0
+        self._lock = threading.Lock()
+        # Telemetry: misses (all slabs busy / batch too big) say whether
+        # the bound is sized to the deployment's concurrency.
+        self.metric_leases = 0
+        self.metric_misses = 0
+
+    @hot_path
+    def lease(self, n: int, blob_cap: int) -> Optional[ArenaLease]:
+        """A slab for an ``n``-row decode needing ``blob_cap`` staging
+        bytes, or None (caller allocates).  The returned views are
+        already zeroed where the decoder requires zeros (proto3 absent
+        fields must read 0)."""
+        if n > self.max_batch or blob_cap > self.blob_cap:
+            self.metric_misses += 1
+            return None
+        with self._lock:
+            idx = -1
+            for k in range(self.n_slabs):
+                j = (self._next + k) % self.n_slabs
+                if not self._busy[j]:
+                    idx = j
+                    break
+            if idx < 0:
+                self.metric_misses += 1
+                return None
+            self._busy[idx] = True
+            self._next = (idx + 1) % self.n_slabs
+            self.metric_leases += 1
+        ints = self._ints[idx]
+        # Zero only the region this decode reads/writes, not the slab:
+        # the decoder writes only fields present on the wire.
+        ints[:, : n + 1] = 0
+        flags = self._flags[idx]
+        flags[:n] = 0
+        return ArenaLease(self, idx, ints, flags, self._blob[idx])
+
+    def _release(self, index: int) -> None:
+        with self._lock:
+            self._busy[index] = False
+
+    def in_use(self) -> int:
+        with self._lock:
+            return sum(self._busy)

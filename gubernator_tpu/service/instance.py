@@ -201,6 +201,22 @@ class V1Instance:
             batch_limit=window_limit,
             metrics=self.metrics,
         )
+        # Zero-copy ingest (docs/tpu-performance.md): the transport's
+        # wire→columns decode lands in these preallocated slabs instead
+        # of fresh per-batch allocations; the tick loop releases each
+        # slab once the engine has packed it.  Sized to the public API
+        # batch cap; slab count covers the tick pipeline depth plus
+        # decode concurrency (GUBER_INGEST_ARENA_SLABS, 0 = off).
+        from gubernator_tpu.config import env_knob
+        from gubernator_tpu.ops.reqcols import ColumnArena
+
+        try:
+            slabs = env_knob("GUBER_INGEST_ARENA_SLABS", 8, parse=int)
+        except ValueError:
+            slabs = 8
+        self.ingest_arena = (
+            ColumnArena(MAX_BATCH_SIZE, slabs=slabs) if slabs > 0 else None
+        )
         hash_fn = HASH_FUNCTIONS[conf.picker_hash]
         self._standalone = True  # no peers installed yet; see set_peers
         self.local_picker: ReplicatedConsistentHash[PeerClient] = (

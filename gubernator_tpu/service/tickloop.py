@@ -32,23 +32,33 @@ from gubernator_tpu.utils.hotpath import hot_path
 
 _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 
-# How many dispatched-but-unresolved windows may be in flight.  2 is full
-# double-buffering; deeper rides out D2H jitter AND matters directly on
-# high-RTT links: the resolver drains every queued window into ONE
-# device-to-host transfer, so depth bounds how many windows amortize each
-# round trip (profiled: the serving path's CPU is ~3 ms/1000-item batch;
-# the round trip is what queues).  The bound is the backpressure: when
-# the device falls behind, dispatch blocks here instead of queueing
-# unbounded work.  GUBER_TICK_PIPELINE_DEPTH overrides — a registry
-# read (config.env_knob), cached here at import so the serving path
-# never touches the environment.
+# Default for how many dispatched-but-unresolved windows may be in
+# flight.  2 is full double-buffering; deeper rides out D2H jitter AND
+# matters directly on high-RTT links: the resolver drains every queued
+# window into ONE device-to-host transfer, so depth bounds how many
+# windows amortize each round trip.  The bound is the backpressure:
+# when the device falls behind, dispatch blocks instead of queueing
+# unbounded work.  GUBER_TICK_PIPELINE_DEPTH overrides — read via the
+# config registry at TickLoop construction (NOT import: an import-time
+# read froze the knob for the whole process, so config changes and
+# tests silently saw the stale value).
 from gubernator_tpu.config import env_knob
 
-try:
-    PIPELINE_DEPTH = max(1, env_knob(
-        "GUBER_TICK_PIPELINE_DEPTH", 4, parse=int))
-except ValueError:
-    PIPELINE_DEPTH = 4
+DEFAULT_PIPELINE_DEPTH = 4
+
+
+def resolve_pipeline_depth(depth=None) -> int:
+    """The effective tick pipeline depth: an explicit constructor value
+    wins, else GUBER_TICK_PIPELINE_DEPTH, else the default — evaluated
+    at call time so the environment is re-read per constructed loop."""
+    if depth is not None:
+        return max(1, int(depth))
+    try:
+        return max(1, env_knob(
+            "GUBER_TICK_PIPELINE_DEPTH", DEFAULT_PIPELINE_DEPTH,
+            parse=int))
+    except ValueError:
+        return DEFAULT_PIPELINE_DEPTH
 
 
 def _complete(fut: Future, result) -> None:
@@ -80,11 +90,13 @@ class TickLoop:
         batch_wait: float = 500e-6,
         batch_limit: int = 1000,
         metrics=None,
+        pipeline_depth: int = None,
     ):
         self.engine = engine
         self.batch_wait = float(batch_wait)
         self.batch_limit = int(batch_limit)
         self.metrics = metrics
+        self.pipeline_depth = resolve_pipeline_depth(pipeline_depth)
         # Engine counter mirrors already synced into prometheus families
         # (the engine counts in plain ints; deltas flow here per tick).
         self._synced_hits = 0
@@ -98,7 +110,8 @@ class TickLoop:
         self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
         self._running = True
-        self._resolve_q: "queue.Queue" = queue.Queue(maxsize=PIPELINE_DEPTH)
+        self._resolve_q: "queue.Queue" = queue.Queue(
+            maxsize=self.pipeline_depth)
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="tick-loop"
         )
@@ -208,9 +221,16 @@ class TickLoop:
                 ))
             except Exception as e:
                 _fail_waiters(col_items, e)
+            finally:
+                # Arena-backed batches (fastwire decode slabs) recycle
+                # the moment the engine has packed them — submit_cols
+                # copies every column into the device request matrix
+                # before returning, so the views are dead here.
+                for p in col_parts:
+                    p.release()
         if not subs:
             return
-        # Bounded handoff: blocks when PIPELINE_DEPTH windows are already
+        # Bounded handoff: blocks when pipeline_depth windows are already
         # in flight (device behind), which is exactly the backpressure the
         # dispatch thread should feel.
         self._resolve_q.put((subs, time.perf_counter() - t0))
@@ -353,6 +373,8 @@ class TickLoop:
             m.cold_size.set(len(cold))
         if hasattr(self.engine, "hot_occupancy"):
             m.hot_occupancy.set(self.engine.hot_occupancy())
+        if hasattr(self.engine, "h2d_overlap_ratio"):
+            m.h2d_overlap_ratio.set(self.engine.h2d_overlap_ratio())
 
     def _drain_resolve_q(self, err: Exception) -> None:
         """Fail every window still queued for resolution.  A drained None

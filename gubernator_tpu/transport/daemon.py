@@ -140,17 +140,23 @@ def _item_responses(mat, errs):
     ]
 
 
-async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type):
+async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
+                            arena=None):
     """The shared raw-bytes fast path of both rate-limit edges: native
     wire parse → columns → device tick → native wire encode, with no
     protobuf objects.  Returns ``(result, msg)``: ``result`` is the
     response (bytes, or a per-item response list for the error
     fallback) or None when the batch needs the object path; ``msg`` is
     the protobuf message if one was already parsed along the way (so
-    the caller's object path doesn't parse twice)."""
+    the caller's object path doesn't parse twice).
+
+    ``arena`` (the instance's ingest ColumnArena) makes the decode land
+    in a preallocated slab — zero per-batch allocation.  The tick loop
+    releases the slab after packing; batches that bail to the object
+    path release it here."""
     msg = None
     if gate_ok:
-        parsed = fastwire.parse_req(raw)
+        parsed = fastwire.parse_req(raw, arena)
         if parsed is None:  # codec unavailable or malformed bytes
             msg = await _parse_pb(msg_type, raw, context)
             parsed = convert.columns_from_pb(msg.requests)
@@ -159,12 +165,14 @@ async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type):
             try:
                 mat, errs = await tick(cols)
             except BatchTooLargeError as e:
+                cols.release()  # rejected before the tick loop saw it
                 await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
             if not errs:
                 # Native wire encoding straight from the matrix; the
                 # method's pass-through serializer ships bytes as-is.
                 return fastwire.encode_resp(mat), msg
             return _item_responses(mat, errs), msg
+        cols.release()  # object path re-parses; the slab is dead weight
     return None, msg
 
 
@@ -188,6 +196,7 @@ class V1Servicer:
             self.instance.columns_fast_path_ok(),
             self.instance.get_rate_limits_columns,
             pb.GetRateLimitsReq,
+            arena=self.instance.ingest_arena,
         )
         if fast is not None:
             if isinstance(fast, bytes):
@@ -229,6 +238,7 @@ class PeersServicer:
             self.instance.peer_columns_fast_path_ok(),
             self.instance.get_peer_rate_limits_columns,
             peers_pb.GetPeerRateLimitsReq,
+            arena=self.instance.ingest_arena,
         )
         if fast is not None:
             if isinstance(fast, bytes):

@@ -26,8 +26,13 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from gubernator_tpu import native as native_mod
-from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns
+from gubernator_tpu.ops.reqcols import (
+    CREATED_UNSET,
+    ColumnArena,
+    ReqColumns,
+)
 from gubernator_tpu.types import Behavior
+from gubernator_tpu.utils.hotpath import hot_path
 
 _I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _U8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -87,8 +92,9 @@ def load() -> Optional[ctypes.CDLL]:
     return lib
 
 
+@hot_path
 def parse_req(
-    data: bytes,
+    data: bytes, arena: Optional[ColumnArena] = None,
 ) -> Optional[Tuple[ReqColumns, Dict[int, str], bool]]:
     """Serialized ``GetRateLimitsReq`` → (cols, per-item errors, special).
 
@@ -96,7 +102,15 @@ def parse_req(
     (those route through the object path, which re-parses with protobuf —
     the codec records metadata *presence* only).  Returns None when the
     native library is unavailable or the bytes are malformed (caller
-    falls back to ``pb.GetRateLimitsReq.FromString``)."""
+    falls back to ``pb.GetRateLimitsReq.FromString``).
+
+    With ``arena`` (ops.reqcols.ColumnArena) the decode lands in a
+    preallocated slab and the returned columns are views into it —
+    zero per-window allocation besides the key blob's bytes.  The
+    caller owns the lease: ``cols.release()`` once the engine has
+    packed the batch (an unreleased lease just falls back to plain
+    allocation when the arena runs dry, never corrupts).  Oversized
+    batches silently skip the arena."""
     lib = load()
     if lib is None:
         return None
@@ -107,39 +121,51 @@ def parse_req(
     if n == 0:
         return ReqColumns.empty(), {}, False
     blob_cap = ln + n
-    blob = np.empty(blob_cap, np.uint8)
-    # One zeroed block for all int64 outputs (native writes only the
-    # fields present on the wire; proto3 absents must read 0): a single
-    # memset beats ten allocations at serving batch rates.
-    ints = np.zeros((9, n + 1), np.int64)
-    off = ints[8]
+    lease = arena.lease(n, blob_cap) if arena is not None else None
+    if lease is not None:
+        ints = lease.ints
+        blob = lease.blob
+        flags_full = lease.flags
+    else:
+        blob = np.empty(blob_cap, np.uint8)
+        # One zeroed block for all int64 outputs (native writes only the
+        # fields present on the wire; proto3 absents must read 0): a
+        # single memset beats ten allocations at serving batch rates.
+        ints = np.zeros((9, n + 1), np.int64)
+        flags_full = np.zeros(n, np.uint8)
+    off = ints[8, : n + 1]
     name_len, hits, limit, duration, algorithm, behavior, burst, created = (
         ints[i, :n] for i in range(8)
     )
-    flags = np.zeros(n, np.uint8)
+    flags = flags_full[:n]
     got = lib.guber_parse_req(
-        data, ln, blob, blob_cap, off, name_len,
+        data, ln, blob, len(blob), off, name_len,
         hits, limit, duration, algorithm, behavior, burst, created, flags,
     )
     if got != n:
+        if lease is not None:
+            lease.release()
         return None
     # created_at: absent OR explicit 0 → "server stamps now"
     # (convert.columns_from_pb parity).
     created[created == 0] = CREATED_UNSET
     errors: Dict[int, str] = {}
-    if (flags & (_NAME_EMPTY | _KEY_EMPTY)).any():
+    # guber: allow-G001(flags is host numpy, never a device value)
+    if bool((flags & (_NAME_EMPTY | _KEY_EMPTY)).any()):
         for i in np.flatnonzero(flags & (_NAME_EMPTY | _KEY_EMPTY)):
             errors[int(i)] = (
                 "field 'unique_key' cannot be empty"
                 if flags[i] & _KEY_EMPTY
                 else "field 'namespace' cannot be empty"
             )
+    # guber: allow-G001(flags/behavior are host numpy, never device)
     special = bool((flags & _HAS_METADATA).any()) or bool(
         (behavior & _GLOBAL).any()
     )
     cols = ReqColumns(
         blob[: off[n]].tobytes(), off, hits, limit, duration,
         algorithm, behavior, created, burst, name_len=name_len,
+        lease=lease,
     )
     return cols, errors, special
 
@@ -203,6 +229,7 @@ def encode_req(cols: ReqColumns, tag_peer: bool = False) -> Optional[bytes]:
         cap = -wrote
 
 
+@hot_path
 def encode_resp(mat: np.ndarray) -> bytes:
     """(5, n) response matrix → serialized ``GetRateLimitsResp`` bytes.
     Native when available, else the vectorized numpy encoder

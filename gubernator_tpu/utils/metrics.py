@@ -235,6 +235,13 @@ class Metrics:
             "(0.0-1.0).",
             registry=reg,
         )
+        self.h2d_overlap_ratio = Gauge(
+            "gubernator_tpu_h2d_overlap_ratio",
+            "Fraction of serving windows whose request upload was "
+            "dispatched while an earlier window's tick was still "
+            "unresolved (0.0 serial, ~1.0 pipelined steady state).",
+            registry=reg,
+        )
         self.shed_requests = Counter(
             "gubernator_tpu_shed_requests",
             "Requests answered with a per-item 'table full' error "

@@ -54,6 +54,39 @@ COUNT_KEYS = (
     "ownership_transfer_loss",
 )
 
+# Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
+# Unlike COUNT_KEYS these carry timing noise, so each gets its own
+# direction-aware slack instead of the exact 1.05 count comparison:
+#   serve_cpu_ms_per_batch  host codec+arena CPU per 1000-item batch —
+#                           lower is better, 1.3x slack (sub-ms figure
+#                           on a shared CI host jitters)
+#   loopback_p99_ms         the loopback rung's MEASURED end-to-end
+#                           batch p99 — lower is better, 1.5x slack
+#                           (tail latency is the noisiest honest number
+#                           in the ladder)
+LOWER_BETTER_SLACK = {
+    "serve_cpu_ms_per_batch": 1.3,
+    "loopback_p99_ms": 1.5,
+}
+#   h2d_overlap_ratio       fraction of serving windows whose request
+#                           upload overlapped an earlier window's tick
+#                           — HIGHER is better; candidate must keep
+#                           >= 0.9x the baseline's ratio...
+HIGHER_BETTER_FLOOR = {
+    "h2d_overlap_ratio": 0.9,
+}
+# ...and, baseline or not, a pipelined dispatch that stops overlapping
+# at all is a regression in its own right: absolute floor on the
+# candidate (the rung drives depth-8 concurrency, so a healthy pipeline
+# sits near 1.0; 0.5 is the alarm threshold, not the target).
+ABSOLUTE_MIN_KEYS = {
+    "h2d_overlap_ratio": 0.5,
+}
+
+GATED_VALUE_KEYS = (
+    COUNT_KEYS + tuple(LOWER_BETTER_SLACK) + tuple(HIGHER_BETTER_FLOOR)
+)
+
 # Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
 # the rung: each is an absolute correctness invariant, not a relative
 # performance figure.
@@ -139,19 +172,19 @@ def rates(doc):
 
 
 def counts(doc):
-    """(rung, count_key) → value for the exact work-count metrics
-    (COUNT_KEYS).  Unlike rates these carry no sampling noise, so the
-    gate compares them directly: candidate > baseline fails."""
+    """(rung, key) → value for the gated per-rung value metrics: the
+    exact work counts (COUNT_KEYS, compared directly — no sampling
+    noise) plus the direction-aware serving-path perf keys."""
     out = {}
     for rung in doc.get("ladder", []):
-        for k in COUNT_KEYS:
+        for k in GATED_VALUE_KEYS:
             if rung.get(k) is not None:
                 out[(rung["rung"], k)] = float(rung[k])
     # Compact headline records carry the same counts under "counts"
     # (rung → {key: value}) — the full ladder wins on conflicts.
     for name, kv in doc.get("counts", {}).items():
         for k, v in kv.items():
-            if k in COUNT_KEYS and v is not None:
+            if k in GATED_VALUE_KEYS and v is not None:
                 out.setdefault((name, k), float(v))
     return out
 
@@ -236,12 +269,34 @@ def main():
         b, c = base_counts[key], cand_counts[key]
         name = f"{key[0]}.{key[1]}"
         gated += 1
-        # Exact counts: tiny slack only for the rare-overflow steps that
-        # can legitimately land inside a sample window.
-        mark = "FAIL" if c > b * 1.05 + 1e-9 else "ok"
+        if key[1] in LOWER_BETTER_SLACK:
+            allowed = b * LOWER_BETTER_SLACK[key[1]] + 1e-9
+            mark = "FAIL" if c > allowed else "ok"
+            kind = "perf, lower is better"
+        elif key[1] in HIGHER_BETTER_FLOOR:
+            allowed = b * HIGHER_BETTER_FLOOR[key[1]] - 1e-9
+            mark = "FAIL" if c < allowed else "ok"
+            kind = "perf, higher is better"
+        else:
+            # Exact counts: tiny slack only for the rare-overflow steps
+            # that can legitimately land inside a sample window.
+            mark = "FAIL" if c > b * 1.05 + 1e-9 else "ok"
+            kind = "count, lower is better"
         if mark == "FAIL":
             failed = True
-        print(f"  {name}: {b:g} -> {c:g} (count, lower is better, {mark})")
+        print(f"  {name}: {b:g} -> {c:g} ({kind}, {mark})")
+    # Absolute floors hold for the candidate even when BOTH records
+    # carry the key (a baseline that already collapsed must not grant
+    # the candidate a free pass).
+    for key, v in sorted(cand_counts.items()):
+        floor = ABSOLUTE_MIN_KEYS.get(key[1])
+        if floor is not None:
+            gated += 1
+            mark = "FAIL" if v < floor else "ok"
+            if v < floor:
+                failed = True
+            print(f"  {key[0]}.{key[1]}: {v:g} "
+                  f"(absolute floor {floor:g}, {mark})")
     for key in sorted(set(base_counts) ^ set(cand_counts)):
         if key in cand_counts and key[1] in ABSOLUTE_ZERO_KEYS:
             # Absolute invariants — a re-promoted key losing its consumed

@@ -332,3 +332,118 @@ def test_columnar_client_end_to_end():
             await d.close()
 
     asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# Zero-copy ingest arena: decode-into-slab parity + lease mechanics
+# ----------------------------------------------------------------------
+def _rand_reqs(rng, n):
+    """Randomized request batch spanning the codec's edge cases:
+    negative/huge varints, explicit created_at=0, absent fields,
+    UTF-8 keys."""
+    reqs = []
+    for i in range(n):
+        kw = {}
+        if rng.random() < 0.5:
+            kw["hits"] = int(rng.integers(-(2**40), 2**40))
+        if rng.random() < 0.5:
+            kw["limit"] = int(rng.integers(0, 2**62))
+        if rng.random() < 0.5:
+            kw["duration"] = int(rng.integers(-(2**31), 2**31))
+        if rng.random() < 0.3:
+            kw["burst"] = int(rng.integers(0, 2**31))
+        if rng.random() < 0.3:
+            kw["algorithm"] = int(rng.integers(0, 2))
+        if rng.random() < 0.3:
+            # Any behavior bits except GLOBAL (2): GLOBAL flips the
+            # special flag, which is its own (covered) route.
+            kw["behavior"] = int(rng.choice([1, 4, 8, 16]))
+        if rng.random() < 0.3:
+            kw["created_at"] = int(rng.integers(0, 2**50))
+        name = rng.choice(["svc", "s" * int(rng.integers(1, 40)), "Ω≈"])
+        reqs.append(pb.RateLimitReq(
+            name=name, unique_key=f"k{i}-{rng.integers(0, 10**9)}", **kw
+        ))
+    return reqs
+
+
+def test_arena_decode_fuzz_parity():
+    """Fuzzed wire batches must decode into arena slabs identically to
+    both the plain decode and the protobuf object path — the zero-copy
+    ingest pipeline changes allocation, never values."""
+    from gubernator_tpu.ops.reqcols import ColumnArena
+
+    rng = np.random.default_rng(11)
+    arena = ColumnArena(512, slabs=3)
+    for trial in range(6):
+        reqs = _rand_reqs(rng, int(rng.integers(1, 400)))
+        data = _req_bytes(reqs)
+        plain = fastwire.parse_req(data)
+        slab = fastwire.parse_req(data, arena)
+        assert plain is not None and slab is not None
+        pc, pe, ps = plain
+        sc, se, ss = slab
+        assert sc.lease is not None, "arena lease was not used"
+        assert pe == se and ps == ss
+        assert pc.key_blob == sc.key_blob
+        np.testing.assert_array_equal(pc.key_offsets, sc.key_offsets)
+        for f in ("hits", "limit", "duration", "algorithm", "behavior",
+                  "created_at", "burst", "name_len"):
+            np.testing.assert_array_equal(
+                getattr(pc, f), getattr(sc, f), err_msg=f"{f} trial {trial}"
+            )
+        # Object-path parity (columns_from_pb is the reference).
+        ref_cols, ref_errors, ref_special = convert.columns_from_pb(
+            pb.GetRateLimitsReq.FromString(data).requests
+        )
+        assert se == ref_errors and ss == ref_special
+        assert sc.key_blob == ref_cols.key_blob
+        for f in ("hits", "limit", "duration", "algorithm", "behavior",
+                  "created_at", "burst"):
+            np.testing.assert_array_equal(
+                getattr(sc, f), getattr(ref_cols, f), err_msg=f
+            )
+        sc.release()
+        sc.release()  # idempotent
+    assert arena.in_use() == 0
+
+
+def test_arena_exhaustion_and_oversize_fall_back():
+    """The arena is a bounded fast path: all-slabs-busy and oversized
+    batches fall back to plain allocation, never fail or block."""
+    from gubernator_tpu.ops.reqcols import ColumnArena
+
+    arena = ColumnArena(8, slabs=2)
+    small = _req_bytes(_rand_reqs(np.random.default_rng(0), 4))
+    big = _req_bytes(_rand_reqs(np.random.default_rng(1), 64))
+    a = fastwire.parse_req(small, arena)[0]
+    b = fastwire.parse_req(small, arena)[0]
+    assert a.lease is not None and b.lease is not None
+    c = fastwire.parse_req(small, arena)[0]  # both slabs busy
+    assert c.lease is None
+    np.testing.assert_array_equal(a.hits, c.hits)
+    d = fastwire.parse_req(big, arena)[0]    # wider than the slab
+    assert d.lease is None
+    assert arena.metric_misses == 2
+    a.release()
+    e = fastwire.parse_req(small, arena)[0]  # the slab recycled
+    assert e.lease is not None
+    np.testing.assert_array_equal(e.hits, b.hits)
+
+
+def test_arena_slab_reuse_does_not_alias_live_columns():
+    """A released slab's next decode must not disturb a still-held
+    fallback batch, and two live leases never alias each other."""
+    from gubernator_tpu.ops.reqcols import ColumnArena
+
+    arena = ColumnArena(64, slabs=2)
+    rng = np.random.default_rng(5)
+    d1 = _req_bytes(_rand_reqs(rng, 16))
+    d2 = _req_bytes(_rand_reqs(rng, 16))
+    c1 = fastwire.parse_req(d1, arena)[0]
+    h1 = c1.hits.copy()
+    c2 = fastwire.parse_req(d2, arena)[0]
+    np.testing.assert_array_equal(c1.hits, h1)  # second lease: no alias
+    c1.release()
+    c3 = fastwire.parse_req(d2, arena)[0]       # reuses c1's slab
+    np.testing.assert_array_equal(c3.hits, c2.hits)

@@ -164,3 +164,42 @@ def test_handle_limit_snapshot_survives_caller_mutation():
     assert not errors
     assert rm[1, 0] == 100  # the limit at submit time, not 777
     eng.close()
+
+
+def test_tickloop_pipeline_depth_env_read_at_init(monkeypatch):
+    """GUBER_TICK_PIPELINE_DEPTH must take effect at TickLoop
+    construction — the old import-time read froze the knob for the
+    process, so config changes and tests silently saw the stale
+    value."""
+    from gubernator_tpu.service.tickloop import TickLoop
+
+    class _NoEngine:  # never flushed: submit is never called
+        pass
+
+    monkeypatch.setenv("GUBER_TICK_PIPELINE_DEPTH", "7")
+    loop = TickLoop(_NoEngine())
+    try:
+        assert loop.pipeline_depth == 7
+        assert loop._resolve_q.maxsize == 7
+    finally:
+        loop.close()
+
+    monkeypatch.setenv("GUBER_TICK_PIPELINE_DEPTH", "2")
+    loop = TickLoop(_NoEngine())
+    try:
+        assert loop.pipeline_depth == 2  # no re-import needed
+    finally:
+        loop.close()
+
+    # Explicit constructor arg beats the environment; junk falls back.
+    loop = TickLoop(_NoEngine(), pipeline_depth=3)
+    try:
+        assert loop.pipeline_depth == 3
+    finally:
+        loop.close()
+    monkeypatch.setenv("GUBER_TICK_PIPELINE_DEPTH", "not-an-int")
+    loop = TickLoop(_NoEngine())
+    try:
+        assert loop.pipeline_depth == 4
+    finally:
+        loop.close()

@@ -493,17 +493,24 @@ def test_repo_hot_path_markers_present():
     proj = load_project(REPO_ROOT, "gubernator_tpu")
     expected = {
         "gubernator_tpu/ops/engine.py": [
-            "_build_cols", "_promote_misses", "submit_columns",
-            "submit_cols", "submit"],
+            "_build_cols", "_lease_matrix", "_promote_misses",
+            "submit_columns", "submit_cols", "submit"],
         "gubernator_tpu/parallel/mesh_engine.py": [
             "submit_columns", "submit_cols", "submit"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
+        # Zero-copy ingest edge: the wire decode/encode and the arena
+        # lease run once per serving window too.
+        "gubernator_tpu/ops/reqcols.py": ["lease"],
+        "gubernator_tpu/transport/fastwire.py": ["parse_req",
+                                                 "encode_resp"],
     }
     for path, names in expected.items():
         text = proj.by_path[path].text
         for name in names:
-            assert f"@hot_path\n    def {name}(" in text, (
-                f"{path}: {name} lost its @hot_path marker")
+            assert (
+                f"@hot_path\n    def {name}(" in text
+                or f"@hot_path\ndef {name}(" in text
+            ), f"{path}: {name} lost its @hot_path marker"
 
 
 def test_all_six_rules_registered():
