@@ -1684,58 +1684,251 @@ def rung_restart_recovery():
 # ----------------------------------------------------------------------
 def child_mesh_tick():
     """Runs in the subprocess: MeshTickEngine over an 8-device mesh —
-    the multi-chip WorkerPool analog (one table sharded over the mesh,
-    per-shard request blocks, no collectives on the hot path)."""
+    the multi-chip WorkerPool analog, on the device-routed serving path
+    (one flat slot-sorted batch per tick, each shard compacts its own
+    rows on device, responses gathered with one psum).
+
+    Exports the scaling story and the exact-work invariants the CI gate
+    holds (scripts/check_bench_regression.py):
+
+      mesh_scaling_efficiency     8-dev rate / (8 x 1-dev rate) — the
+                                  near-linear-scaling observable
+                                  (direction-aware gate: must not decay)
+      mesh_routing_parity_errors  device-derived ownership vs the host
+                                  hash ring on a served-key sample
+                                  (ABSOLUTE_ZERO)
+      mesh_dropped_keys /         issued vs resolved decision counts
+      mesh_double_served          (ABSOLUTE_ZERO both ways)
+    """
     jax.config.update("jax_platforms", "cpu")
-    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
-    from gubernator_tpu.types import RateLimitRequest
-
-    n_nodes = 8
-    batch = 512
-    eng = MeshTickEngine(
-        mesh=make_mesh(), local_capacity=1 << 13, max_batch=batch
-    )
-    rng = np.random.default_rng(5)
-
-    def window():
-        return [
-            RateLimitRequest(
-                name="m", unique_key=str(k), hits=1, limit=1_000_000,
-                duration=3_600_000,
-            )
-            for k in rng.integers(0, 1 << 15, n_nodes * batch)
-        ]
-
     from gubernator_tpu.ops.engine import resolve_ticks
-    from gubernator_tpu.ops.reqcols import ReqColumns
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
 
-    eng.process(window(), now=1_700_000_000_000)  # warm/compile
-    windows = [
-        ReqColumns.from_requests(window()) for _ in range(4)
-    ]
+    batch = 1024
+    n_keys = 1 << 12   # fits the 1-dev table too: scaling, not reclaim
+    now = 1_700_000_000_000
     iters = 5 if FAST else 20
-    t0 = time.perf_counter()
-    done = 0
-    pending = []
-    for i in range(iters):
-        w = windows[i % len(windows)]
-        # The round-3 verdict's ask: the mesh rung rides the columnar
-        # submit_cols path (chunked ≤ max_batch ticks, dispatch
-        # pipelined, many windows resolved per D2H).
-        pending.extend(
-            eng.submit_cols(w, now=1_700_000_000_000 + i).handles())
-        done += len(w)
-        if len(pending) >= 16:
-            resolve_ticks(pending)
-            pending.clear()
-    resolve_ticks(pending)
-    dt = time.perf_counter() - t0
+    rng = np.random.default_rng(5)
+    # Unique-key windows (permutations of the keyspace): both rungs run
+    # the parts-native unique program, and every key is served — the
+    # parity sweep can audit the whole keyspace.
+    window_ids = [rng.permutation(n_keys) for _ in range(4)]
+    windows = [_cols(ids, 1_000_000, 3_600_000, 0) for ids in window_ids]
+
+    def run(devs, routing):
+        eng = MeshTickEngine(
+            mesh=make_mesh(devs), local_capacity=1 << 13, max_batch=batch,
+            routing=routing,
+        )
+        for c in windows:  # warm/compile + make all keys known
+            eng.process_columns(c, now=now)
+        h0, m0 = eng.metric_hits, eng.metric_misses
+        t0 = time.perf_counter()
+        done = 0
+        pending = []
+        for i in range(iters):
+            c = windows[i % len(windows)]
+            pending.extend(eng.submit_cols(c, now=now + 1 + i).handles())
+            done += len(c)
+            if len(pending) >= 16:
+                resolve_ticks(pending)
+                pending.clear()
+        resolve_ticks(pending)
+        dt = time.perf_counter() - t0
+        resolved = (eng.metric_hits - h0) + (eng.metric_misses - m0)
+        return eng, done / dt, done, resolved
+
+    eng1, rate1, _, _ = run(jax.devices()[:1], "device")
+    del eng1  # release each table before building the next
+    engh, rate_host, _, _ = run(jax.devices(), "host")
+    del engh
+    n_nodes = len(jax.devices())
+    eng8, rate8, done8, resolved8 = run(jax.devices(), "device")
+    work_delta = resolved8 - done8
+    sample = ["bench_" + str(i) for i in range(n_keys)]
     print(
         json.dumps(
             {
                 "rung": "mesh_tick_8",
                 "shards": n_nodes,
+                "batch": batch,
+                "decisions_per_sec": round(rate8, 1),
+                "decisions_per_sec_1dev": round(rate1, 1),
+                "decisions_per_sec_host_routing": round(rate_host, 1),
+                # On-device routing vs the round-5 host-blocked packer,
+                # same mesh/shape — the win demonstrable on this venue.
+                "routed_vs_host_routing": round(
+                    rate8 / max(rate_host, 1e-9), 3),
+                # 8-dev vs ideal 8 x 1-dev.  NOTE the venue: the 8
+                # "devices" are XLA CPU virtual devices time-slicing ONE
+                # host core, so the physical ceiling here is 1/shards
+                # (0.125) minus routing/psum overhead — the gate holds
+                # the figure from decaying run-over-run; the >=6x
+                # near-linear target is the real-multichip (MULTICHIP_r*)
+                # acceptance, where per-shard lanes execute in parallel.
+                "mesh_scaling_efficiency": round(
+                    rate8 / max(n_nodes * rate1, 1e-9), 4
+                ),
+                "mesh_routing_parity_errors": int(
+                    eng8.routing_parity_errors(sample)
+                ),
+                "mesh_dropped_keys": int(max(-work_delta, 0)),
+                "mesh_double_served": int(max(work_delta, 0)),
+                "routed_windows": eng8.metric_routed_windows,
+                "routed_overflows": eng8.metric_routed_overflows,
+                "layout": eng8.layout,
+                "backend": "cpu-8dev",
+            }
+        )
+    )
+
+
+def child_mesh_100m():
+    """Runs in the subprocess: the 100M-key multichip rung — the full
+    sharded SoA table (8 shards x 12.5M slots, columns layout: 80 B/slot
+    = 8 GB total, ~1 GB/shard HBM on real chips) under device-routed
+    serving traffic, with the same exact-work gates as mesh_tick_8.
+
+    The table is populated DEVICE-SIDE per shard (one donated shard_map
+    init writes synthetic bucket state straight into every shard's
+    slice, the rung_100m trick) while the host assigns the keys into
+    each shard's slotmap grouped by the SAME CRC-32 route the serving
+    path uses, so host and device agree on key→shard→slot.  BENCH_FAST
+    shrinks to 2M keys (the shape key keeps the gate like-for-like)."""
+    jax.config.update("jax_platforms", "cpu")
+    from functools import partial
+
+    from gubernator_tpu.native import crc32_batch
+    from gubernator_tpu.ops.buckets import BucketState, to_stored
+    from gubernator_tpu.ops.engine import resolve_ticks
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+    from gubernator_tpu.utils.jaxcompat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_nodes = 8
+    total = 2_000_000 if FAST else 100_000_000
+    local_cap = total // n_nodes
+    now = 1_700_000_000_000
+    limit = 1_000_000
+    duration = 3_600_000
+    batch = 4096
+    t_build0 = time.perf_counter()
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=local_cap, max_batch=batch,
+        table_layout="columns",
+    )
+
+    def synth_local(state):
+        # All-token fill: the key→slot map is hash-routed here (unlike
+        # rung_100m's identity mapping), so per-slot algorithm choices
+        # can't be tied to key ids — one algorithm keeps request and
+        # stored state consistent for every key.
+        def f64(v):
+            return jnp.full(local_cap, v, jnp.int64)
+
+        return BucketState(
+            algorithm=jnp.zeros(local_cap, jnp.int32),
+            limit=to_stored(f64(limit), "limit"),
+            remaining=to_stored(f64(limit), "remaining"),
+            remaining_f=to_stored(jnp.zeros(local_cap), "remaining_f"),
+            duration=to_stored(f64(duration), "duration"),
+            created_at=to_stored(f64(now), "created_at"),
+            updated_at=to_stored(f64(0), "updated_at"),
+            burst=to_stored(f64(0), "burst"),
+            status=jnp.zeros(local_cap, jnp.int32),
+            expire_at=to_stored(f64(now + duration), "expire_at"),
+            in_use=jnp.ones(local_cap, jnp.bool_),
+        )
+
+    state_spec = eng.ops.state_spec
+    synth = jax.jit(
+        shard_map(
+            lambda st: synth_local(st), mesh=eng.mesh,
+            in_specs=(state_spec,), out_specs=state_spec, check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+    eng.state = synth(eng.state)
+    jax.block_until_ready(jax.tree.leaves(eng.state)[0])
+    dev_fill_s = time.perf_counter() - t_build0
+
+    # Host side: route every key with the vectorized CRC-32 batch and
+    # assign it into its shard's slotmap (hash imbalance overflows a
+    # shard for the last ~sqrt fraction; those ids are simply not part
+    # of the traffic set — the rung measures serving, not insert).
+    t0 = time.perf_counter()
+    served_ids = []
+    step = 10_000_000
+    for start in range(0, total, step):
+        ids = np.arange(start, min(start + step, total))
+        blob, offsets = _key_pack(ids)
+        sh = (
+            crc32_batch(blob, offsets) % np.uint32(n_nodes)
+        ).astype(np.int64)
+        blob_arr = np.frombuffer(blob, np.uint8)
+        offs = offsets
+        lens = np.diff(offs)
+        for s in range(n_nodes):
+            rows = np.flatnonzero(sh == s)
+            if not len(rows):
+                continue
+            lo = lens[rows]
+            cum = np.cumsum(lo)
+            gather = (
+                np.arange(int(cum[-1]), dtype=np.int64)
+                - np.repeat(cum - lo, lo)
+                + np.repeat(offs[:-1][rows], lo)
+            )
+            s_off = np.concatenate([np.zeros(1, np.int64), cum])
+            got = eng.slots[s].assign_blob(
+                blob_arr[gather].tobytes(), s_off
+            )
+            served_ids.append(ids[rows[got >= 0]])
+    served = np.concatenate(served_ids)
+    key_fill_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(7)
+    cols_windows = [
+        _cols(served[rng.integers(0, len(served), batch)],
+              limit, duration, 0)
+        for _ in range(8)
+    ]
+
+    eng.process_columns(cols_windows[0], now=now)  # warm/compile
+    h0, m0 = eng.metric_hits, eng.metric_misses
+    done = 0
+    pending = []
+    iters = 6 if FAST else 24
+    t0 = time.perf_counter()
+    for i in range(iters):
+        c = cols_windows[i % len(cols_windows)]
+        pending.extend(eng.submit_cols(c, now=now + 1 + i).handles())
+        done += len(c)
+        if len(pending) >= 8:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
+    dt = time.perf_counter() - t0
+    resolved = (eng.metric_hits - h0) + (eng.metric_misses - m0)
+    work_delta = resolved - done
+    sample = ["bench_" + str(i) for i in served[:4096]]
+    print(
+        json.dumps(
+            {
+                "rung": "mesh_100m_multichip",
+                "keys": total,
+                "shards": n_nodes,
+                "batch": batch,
                 "decisions_per_sec": round(done / dt, 1),
+                "mesh_routing_parity_errors": int(
+                    eng.routing_parity_errors(sample)
+                ),
+                "mesh_dropped_keys": int(max(-work_delta, 0)),
+                "mesh_double_served": int(max(work_delta, 0)),
+                "routed_windows": eng.metric_routed_windows,
+                "routed_overflows": eng.metric_routed_overflows,
+                "device_fill_s": round(dev_fill_s, 1),
+                "key_fill_s": round(key_fill_s, 1),
                 "layout": eng.layout,
                 "backend": "cpu-8dev",
             }
@@ -1962,6 +2155,13 @@ def rung_mesh_tick():
     return _run_child("--child-mesh-tick", "mesh_tick_8")
 
 
+def rung_mesh_100m():
+    # 8 GB of sharded table + ~8 GB of native slotmaps, populated
+    # device-side; the dominant cost is the 100M host key inserts.
+    return _run_child("--child-mesh-100m", "mesh_100m_multichip",
+                      timeout=1800)
+
+
 def rung_global_sparse():
     # 2^22-capacity engines on the 8-virtual-device CPU backend spend
     # minutes in whole-buffer copies alone; give the child room.
@@ -2118,6 +2318,7 @@ def main():
     ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
+    ladder.append(_safe("mesh_100m_multichip", rung_mesh_100m))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
 
@@ -2287,6 +2488,11 @@ def compact_headline(record, ladder_file):
         # host codec CPU and measured loopback p99 must not regress,
         # the H2D overlap ratio must not collapse.
         "serve_cpu_ms_per_batch", "loopback_p99_ms", "h2d_overlap_ratio",
+        # Sharded-serving gates: routing parity with the host ring and
+        # the issued-vs-resolved work deltas are ABSOLUTE_ZERO; scaling
+        # efficiency is direction-aware (must not decay vs baseline).
+        "mesh_routing_parity_errors", "mesh_dropped_keys",
+        "mesh_double_served", "mesh_scaling_efficiency",
     )
     count_map = {}
     for r in record["ladder"]:
@@ -2304,7 +2510,9 @@ def compact_headline(record, ladder_file):
 
 
 if __name__ == "__main__":
-    if "--child-mesh-tick" in sys.argv:
+    if "--child-mesh-100m" in sys.argv:
+        child_mesh_100m()
+    elif "--child-mesh-tick" in sys.argv:
         child_mesh_tick()
     elif "--child-mesh" in sys.argv:
         child_mesh()

@@ -94,6 +94,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_MEMBERLIST_ADDRESS": "member-list discovery: bind address",
     "GUBER_MEMBERLIST_ADVERTISE_ADDRESS": "member-list: advertise address",
     "GUBER_MEMBERLIST_KNOWN_NODES": "member-list: seed nodes (comma list)",
+    "GUBER_MESH_LOCAL_WIDTH": "routed per-shard block lanes (0 = auto)",
+    "GUBER_MESH_ROUTING": "sharded-table key routing: auto/device/host",
     "GUBER_METRIC_FLAGS": "optional collectors: os,golang",
     "GUBER_PEER_DISCOVERY_TYPE": "discovery pool: member-list/etcd/dns/k8s/none",
     "GUBER_PEER_PICKER": "peer picker implementation",
@@ -220,6 +222,14 @@ class Config:
     # --- TPU engine knobs (new surface; no reference analog) ---
     tpu_max_batch: int = 4096        # request columns per device tick
     tpu_mesh_shards: int = 0         # 0 = single-chip TickEngine; N = mesh
+    # Sharded-table key routing (parallel/mesh_engine.py): "device" (the
+    # "auto" default) ships one flat slot-sorted batch and each shard
+    # compacts its own rows on device; "host" keeps the legacy blocked
+    # per-shard packing.  GUBER_MESH_ROUTING
+    mesh_routing: str = "auto"
+    # Per-shard lanes of the device-routed local block (0 = auto:
+    # ~batch/shards with headroom).  GUBER_MESH_LOCAL_WIDTH
+    mesh_local_width: int = 0
     tpu_platform: str = ""           # force jax platform ("cpu" for tests)
     # Bucket-table storage: "auto" picks the Pallas row layout on TPU for
     # tables it fits (ops/rowtable.py), "columns"/"row" force one.
@@ -561,6 +571,8 @@ def setup_daemon_config(
         tpu_table_layout=r.str_("GUBER_TPU_TABLE_LAYOUT", "auto"),
         tpu_bg_reclaim=r.str_("GUBER_TPU_BG_RECLAIM", "auto"),
         tpu_mesh_shards=r.int_("GUBER_TPU_MESH_SHARDS", 0),
+        mesh_routing=r.str_("GUBER_MESH_ROUTING", "auto"),
+        mesh_local_width=r.int_("GUBER_MESH_LOCAL_WIDTH", 0),
         tpu_platform=r.str_("GUBER_TPU_PLATFORM"),
         tpu_global_mesh_nodes=r.int_("GUBER_TPU_GLOBAL_MESH_NODES", 0),
         tpu_global_mesh_node=r.int_("GUBER_TPU_GLOBAL_MESH_NODE", -1),
@@ -574,6 +586,16 @@ def setup_daemon_config(
         raise ValueError(
             f"GUBER_TPU_BG_RECLAIM must be auto, on, or off; "
             f"got {conf.tpu_bg_reclaim!r}"
+        )
+    if conf.mesh_routing not in ("auto", "device", "host"):
+        raise ValueError(
+            f"GUBER_MESH_ROUTING must be auto, device, or host; "
+            f"got {conf.mesh_routing!r}"
+        )
+    if conf.mesh_local_width < 0:
+        raise ValueError(
+            f"GUBER_MESH_LOCAL_WIDTH must be >= 0; "
+            f"got {conf.mesh_local_width}"
         )
     if conf.cold_cache_size < 0:
         raise ValueError(

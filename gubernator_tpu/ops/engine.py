@@ -343,6 +343,115 @@ def pack_request_matrix32(
         put_wide("greg_dur", greg[1])
 
 
+def pack_cols_req32(m32: np.ndarray, cols, slots, known, now: int, ix) -> None:
+    """Shard-aware columnar REQ32 fill: write one resolved batch's
+    request columns into a staging slab — the ONE definition of how a
+    ``ReqColumns`` batch becomes compact wire rows, shared by the
+    single-chip engine (``TickEngine._build_cols``) and the sharded
+    mesh engine's flat routed packer.
+
+    ``ix`` selects the packed lanes (a slice for the contiguous
+    no-error batch, a fancy index when shed/error rows are skipped).
+    ``slots`` may be LOCAL (single-chip) or GLOBAL (mesh-routed) — the
+    packer doesn't care, which is what makes it shard-aware: ownership
+    is a property of the slot value, not of the wire format."""
+    R = REQ32_INDEX
+    m32[R["slot"], ix] = slots
+    m32[R["known"], ix] = known
+    m32[R["algorithm"], ix] = cols.algorithm[ix]
+    m32[R["behavior"], ix] = cols.behavior[ix]
+    m32[R["valid"], ix] = 1
+    pack_wide_rows(m32, "hits", cols.hits[ix], ix)
+    pack_wide_rows(m32, "limit", cols.limit[ix], ix)
+    pack_wide_rows(m32, "duration", cols.duration[ix], ix)
+    ca = cols.created_at[ix]
+    pack_wide_rows(
+        m32, "created_at", np.where(ca != CREATED_UNSET, ca, now), ix
+    )
+    pack_wide_rows(m32, "burst", cols.burst[ix], ix)
+
+
+def sort_packed_by_slot(m32: np.ndarray, n: int, capacity: int):
+    """Stable in-place sort of a packed REQ32 batch's live lanes by the
+    slot row (same-slot requests keep arrival order — the duplicate-
+    sequencing contract) and duplicate detection against ``capacity``'s
+    padding sentinel.  Returns ``(inv, has_dups)``: the request→sorted-
+    lane permutation (responses un-permute through it) and whether any
+    live slot repeats (routes the batch to the merge-capable program)."""
+    R = REQ32_INDEX
+    order = np.argsort(m32[R["slot"], :n], kind="stable")
+    m32[:, :n] = m32[:, :n][:, order]
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    sl = m32[R["slot"], :n]
+    has_dups = bool(  # guber: allow-G001(m32 is host numpy, never device)
+        ((sl[1:] == sl[:-1]) & (sl[1:] < capacity)).any()
+    )
+    return inv, has_dups
+
+
+class StagingRing:
+    """Reusable host staging slabs for async H2D request uploads — the
+    double-buffered pipeline contract (docs/tpu-performance.md round 6)
+    factored out of ``TickEngine`` so the sharded mesh engine shares one
+    implementation: a slab recycles only once the tick handle that
+    consumed it has resolved (until then jax may still read the host
+    buffer for the in-flight copy), and when every slab is in flight
+    the lease falls back to a fresh allocation rather than corrupting
+    one.  Callers hold their engine lock around lease()/retire() (ring
+    state is unsynchronized)."""
+
+    __slots__ = ("rows", "sentinel", "depth", "_stage", "_next", "_leased")
+
+    def __init__(self, rows: int, sentinel: int, depth: int):
+        self.rows = int(rows)
+        self.sentinel = int(sentinel)
+        self.depth = int(depth)
+        self._stage: Dict[int, list] = {}   # width -> [[matrix, handle]]
+        self._next: Dict[int, int] = {}
+        self._leased: Optional[list] = None
+
+    def lease(self, b: int) -> np.ndarray:
+        """A zeroed (rows, b) slab with the slot row pre-set to the
+        padding sentinel (padding lanes scatter out of bounds)."""
+        ring = self._stage.get(b)
+        if ring is None:
+            ring = self._stage[b] = [
+                [np.empty((self.rows, b), np.int32), None]
+                for _ in range(self.depth)
+            ]
+            self._next[b] = 0
+        slot = None
+        start = self._next[b]
+        for k in range(len(ring)):
+            cand = ring[(start + k) % len(ring)]
+            h = cand[1]
+            if h is None or h._done is not None:
+                slot = cand
+                self._next[b] = (start + k + 1) % len(ring)
+                break
+        if slot is None:
+            # Every slab still feeds an unresolved window (caller is
+            # pipelining deeper than the ring): plain allocation.
+            m = np.empty((self.rows, b), np.int32)
+            self._leased = None
+        else:
+            slot[1] = None
+            m = slot[0]
+            self._leased = slot
+        m.fill(0)
+        m[REQ32_INDEX["slot"]] = self.sentinel
+        return m
+
+    def retire(self, handle) -> None:
+        """Bind the most recent lease to the tick handle consuming it
+        (the slab recycles when that handle resolves); ``None`` frees
+        the slab immediately — the dispatch never uploaded it."""
+        if self._leased is not None:
+            self._leased[1] = handle
+            self._leased = None
+
+
 def join_i32_pair(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     """Host-side (lo, hi) int32 pair → int64 (the compact wire format's
     inverse; two's complement preserved for negatives)."""
@@ -2071,9 +2180,9 @@ class TickEngine:
         except ValueError:
             _depth = 4
         self._stage_depth = 2 * _depth + 1
-        self._stage: Dict[int, list] = {}   # width -> [[matrix, handle]]
-        self._stage_next: Dict[int, int] = {}
-        self._leased_slot: Optional[list] = None
+        self._staging = StagingRing(
+            REQ32_ROWS, self.capacity, self._stage_depth
+        )
         # H2D overlap telemetry: a window counts as overlapped when its
         # upload was dispatched while at least one earlier window was
         # still unresolved — the pipelined steady state.  The bench
@@ -2451,39 +2560,10 @@ class TickEngine:
     @hot_path
     def _lease_matrix(self, b: int) -> np.ndarray:
         """A zeroed (REQ32_ROWS, b) staging slab from the per-width ring
-        (slot row pre-set to the padding sentinel).  Reuses a slab only
-        when the tick that consumed it has resolved — until then jax may
-        still be reading the host buffer for the async H2D — and falls
-        back to a fresh allocation when the whole ring is in flight.
-        Called under the engine lock (ring state is unsynchronized)."""
-        ring = self._stage.get(b)
-        if ring is None:
-            ring = self._stage[b] = [
-                [np.empty((REQ32_ROWS, b), np.int32), None]
-                for _ in range(self._stage_depth)
-            ]
-            self._stage_next[b] = 0
-        slot = None
-        start = self._stage_next[b]
-        for k in range(len(ring)):
-            cand = ring[(start + k) % len(ring)]
-            h = cand[1]
-            if h is None or h._done is not None:
-                slot = cand
-                self._stage_next[b] = (start + k + 1) % len(ring)
-                break
-        if slot is None:
-            # Every slab still feeds an unresolved window (caller is
-            # pipelining deeper than the ring): plain allocation.
-            m = np.empty((REQ32_ROWS, b), np.int32)
-            self._leased_slot = None
-        else:
-            slot[1] = None
-            m = slot[0]
-            self._leased_slot = slot
-        m.fill(0)
-        m[REQ32_INDEX["slot"]] = self.capacity  # padding scatters OOB
-        return m
+        (slot row pre-set to the padding sentinel) — see
+        :class:`StagingRing` for the recycle contract.  Called under the
+        engine lock (ring state is unsynchronized)."""
+        return self._staging.lease(b)
 
     @hot_path
     def _build_cols(self, cols: ReqColumns, now: int):
@@ -2613,38 +2693,17 @@ class TickEngine:
         # (pack_wide_rows) — the compact wire format unpack_reqs_compact
         # reads on device.
         ix = slice(0, n) if sel is None else sel
-
-        m[R["slot"], ix] = slots
-        m[R["known"], ix] = known
-        m[R["algorithm"], ix] = cols.algorithm[ix]
-        m[R["behavior"], ix] = cols.behavior[ix]
-        m[R["valid"], ix] = 1
-        pack_wide_rows(m, "hits", cols.hits[ix], ix)
-        pack_wide_rows(m, "limit", cols.limit[ix], ix)
-        pack_wide_rows(m, "duration", cols.duration[ix], ix)
-        ca = cols.created_at[ix]
-        pack_wide_rows(
-            m, "created_at", np.where(ca != CREATED_UNSET, ca, now), ix
-        )
-        pack_wide_rows(m, "burst", cols.burst[ix], ix)
+        pack_cols_req32(m, cols, slots, known, now, ix)
         # Sort the batch by slot (stable: same-slot requests keep arrival
         # order, the duplicate-sequencing contract).  The tick's
         # sorted-input path then does all segment math with neighbor
         # compares + scans — a host argsort here is ~100x cheaper than
         # the device-side gathers/scatters it replaces.  Error rows
-        # (slot=capacity) sort to the end with the padding.
-        order = np.argsort(m[R["slot"], :n], kind="stable")
-        m[:, :n] = m[:, :n][:, order]
-        inv = np.empty(n, np.int64)
-        inv[order] = np.arange(n)
-        # Sorted neighbors reveal duplicate slots for free; error rows sit
-        # at slot == capacity and don't count.  Unique batches dispatch to
-        # the parts-native program (no 64-bit ops, Mosaic-compilable),
-        # duplicate-bearing ones to the merge-capable program.
-        sl = m[R["slot"], :n]
-        has_dups = bool(  # guber: allow-G001(m is host numpy, never device)
-            ((sl[1:] == sl[:-1]) & (sl[1:] < self.capacity)).any()
-        )
+        # (slot=capacity) sort to the end with the padding; sorted
+        # neighbors then reveal duplicate slots for free (unique batches
+        # dispatch to the parts-native program, duplicate-bearing ones
+        # to the merge-capable program).
+        inv, has_dups = sort_packed_by_slot(m, n, self.capacity)
         return m, n, errors, inv, has_dups
 
     @hot_path
@@ -2758,8 +2817,6 @@ class TickEngine:
             self._last_now = max(self._last_now, now)
             self._tick_count += 1
             packed, n, errors, inv, has_dups = self._build_cols(cols, now)
-            leased = self._leased_slot
-            self._leased_slot = None
             dev_m = None
             # Named range in XProf captures (utils/tracing.py): device
             # tick vs host packing shows up separated in the profile.
@@ -2876,11 +2933,10 @@ class TickEngine:
             if self._inflight > 0:
                 self.metric_h2d_overlapped += 1
             self._inflight += 1
-            if leased is not None:
-                # The slab recycles once this tick resolves; grouped
-                # ticks never uploaded it (dev_m is None) and free it
-                # for the very next lease.
-                leased[1] = handle if dev_m is not None else None
+            # The slab recycles once this tick resolves; grouped ticks
+            # never uploaded it (dev_m is None) and free it for the very
+            # next lease.
+            self._staging.retire(handle if dev_m is not None else None)
             if self.store is not None:
                 handle.result()
             return handle

@@ -83,9 +83,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gubernator_tpu.utils.jaxcompat import shard_map
+
+from gubernator_tpu.parallel.partition import NodeLayout
+
+# The canonical GLOBAL-mesh placement: one replica row per node,
+# reconciled with psum collectives only (partition.py is the single
+# source of every PartitionSpec both mesh engines place data with).
+NODE_LAYOUT = NodeLayout()
 
 from gubernator_tpu.ops.buckets import (
     BucketState,
@@ -248,7 +255,7 @@ def make_global_process_fn(mesh: Mesh, capacity: int, n_nodes: int,
             packed[None],
         )
 
-    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    state_spec = NODE_LAYOUT.replica_spec()
     return shard_map(
         _local,
         mesh=mesh,
@@ -497,7 +504,7 @@ def make_global_sparse_step_fn(mesh: Mesh, capacity: int, n_nodes: int,
         )
         return out + (W,) if with_envelope else out
 
-    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    state_spec = NODE_LAYOUT.replica_spec()
     out_specs = (state_spec, P("node", None, None), P())
     if with_envelope:
         out_specs = out_specs + (P(),)
@@ -771,7 +778,7 @@ def make_global_reconcile_fn(
             jnp.zeros_like(accum_blk),
         )
 
-    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    state_spec = NODE_LAYOUT.replica_spec()
     return shard_map(
         _recon,
         mesh=mesh,
@@ -783,7 +790,7 @@ def make_global_reconcile_fn(
 
 def make_global_evict_fn(mesh: Mesh):
     """Drop slots on every replica + clear their accumulators/stamps."""
-    state_spec = jax.tree.map(lambda _: P("node", None), BucketState.zeros(0))
+    state_spec = NODE_LAYOUT.replica_spec()
 
     def _evict(state_blk, aux_blk, accum_blk, slots):
         st = jax.tree.map(lambda a: a[0], state_blk)
@@ -846,8 +853,8 @@ class MeshGlobalEngine:
             sparse_k = 4096 if self.capacity > (1 << 16) else 0
         self.sparse_k = min(int(sparse_k), self.capacity)
 
-        row = NamedSharding(self.mesh, P("node", None))
-        mat = NamedSharding(self.mesh, P("node", None, None))
+        row = NODE_LAYOUT.shardings(self.mesh, P("node", None))
+        mat = NODE_LAYOUT.shardings(self.mesh, NODE_LAYOUT.mat3())
         self.state: BucketState = jax.tree.map(
             lambda a: jax.device_put(
                 jnp.broadcast_to(a, (self.n_nodes,) + a.shape), row

@@ -25,10 +25,27 @@ supports BOTH table layouts: the int32-column SoA and the Pallas
 row-DMA layout (rowtable.py) — the row layout's ~6-8x tick speedup is not
 forfeited by going multi-chip.
 
-Why not route on-device (all-to-all)?  Keys are strings; hashing and the
-key→slot map live on the host anyway (SURVEY.md §7 "Host/device split"), so
-the host already knows every request's shard — an on-device shuffle would
-add an all-to-all for nothing.
+**On-device routing (the serving default).**  Keys are strings, so
+hashing and the key→slot map stay host-side (SURVEY.md §7 "Host/device
+split") — but everything else the round-5 engine did per shard
+(regrouping the batch, packing one (19, W) block per shard, bookkeeping
+each request's (shard, lane)) is gone from the host: the tick ships ONE
+flat slot-sorted (19, B) compact matrix carrying GLOBAL slots, and each
+device derives its own rows from the slot value alone
+(``slot // local_capacity``; :func:`partition.route_block`), compacts
+them into a narrow (19, local_width) local block, ticks its shard, and
+scatters its responses back to flat lanes — gathered collectively with
+one ``psum`` (:func:`partition.scatter_flat`).  ``local_width`` ≈ B/n
+with headroom is the scaling lever: per-shard tick cost shrinks with
+the shard count at constant batch, host packing is O(B) regardless of
+n, and the upload reuses the single-chip engine's staging-ring/async-
+H2D pipeline (ops.engine.StagingRing) so window N+1's transfer rides
+under window N's tick.  Windows whose per-shard row count exceeds
+``local_width`` (adversarial hash skew — the host knows the counts
+before dispatch) fall back to the legacy host-blocked format for that
+tick, which also remains available wholesale as ``routing="host"``.
+All PartitionSpecs come from :mod:`gubernator_tpu.parallel.partition`,
+the canonical spec helper both mesh engines share.
 """
 
 from __future__ import annotations
@@ -37,11 +54,13 @@ import threading
 import zlib
 from typing import Dict, List, Optional, Sequence
 
+import collections
 import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gubernator_tpu.utils.jaxcompat import shard_map
 
@@ -54,6 +73,7 @@ from gubernator_tpu.ops.engine import (
     REQ32_INDEX,
     REQ32_ROWS,
     RESTORE_CHUNK,
+    StagingRing,
     device_dead_mask,
     items_from_columns,
     join_i32_pair,
@@ -63,9 +83,19 @@ from gubernator_tpu.ops.engine import (
     make_readback_fn,
     make_restore_fn,
     make_tick_fn,
+    masked_over_limit,
+    pack_cols_req32,
+    pack_wide_rows,
     pad_pow2,
     select_reclaim_victims,
+    sort_packed_by_slot,
     split_i64,
+    unpack_resp_compact,
+)
+from gubernator_tpu.parallel.partition import (
+    ShardLayout,
+    route_block,
+    scatter_flat,
 )
 from gubernator_tpu.ops.reqcols import CREATED_UNSET
 from gubernator_tpu.ops.rowtable import ROW_W, RowState
@@ -82,39 +112,48 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 
 
 class ShardedOps:
-    """The per-shard blocked device ops for one (mesh, local_capacity,
-    layout): tick/evict/install/restore/readback, each a shard_map of the
-    corresponding single-chip op, jitted with state donation."""
+    """The per-shard device ops for one (mesh, local_capacity, layout):
+    tick/evict/install/restore/readback, each a shard_map of the
+    corresponding single-chip op, jitted with state donation.  Ticks come
+    in two wire formats — the legacy host-blocked (n_shards, 19, W) and
+    the device-routed flat (19, B) (module docstring) — built from the
+    same per-shard tick closures.
 
-    def __init__(self, mesh: Mesh, local_capacity: int, layout: str):
+    ``trace_counts`` increments once per TRACE of each program (the
+    counter bump runs at trace time only): serving re-dispatch must hit
+    the warmed executables, and tests pin the counts so a signature
+    drift between warmup and serving (e.g. a committed ``device_put``
+    where warmup used ``jnp.asarray``) fails loudly instead of silently
+    re-tracing per tick."""
+
+    def __init__(self, mesh: Mesh, local_capacity: int, layout: str,
+                 local_width: int = 0):
         self.mesh = mesh
         self.layout = layout
         self.local_capacity = local_capacity
         n = mesh.devices.size
+        self.trace_counts = collections.Counter()
+        lay = ShardLayout()
+        self.spec_layout = lay
 
         if layout == "row":
             # Each shard's block is its own (local_cap+1, ROW_W) row table
             # — per-shard guard rows included, so local slot arithmetic
             # inside the block is identical to the single-chip engine's.
-            state_spec = RowState(table=P("shard", None))
-
             def zeros_global():
                 return RowState(
                     table=jnp.zeros((n * (local_capacity + 1), ROW_W), jnp.int32)
                 )
         else:
-            state_spec = jax.tree.map(lambda _: P("shard"), BucketState.zeros(0))
-
             def zeros_global():
                 return BucketState.zeros(n * local_capacity)
 
+        state_spec = lay.table_spec(layout)
         self.state_spec = state_spec
-        self.state_shardings = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec), state_spec
-        )
+        self.state_shardings = lay.shardings(mesh, state_spec)
         self.zeros_global = zeros_global
-        self.block_sharding2 = NamedSharding(mesh, P("shard", None))
-        self.block_sharding3 = NamedSharding(mesh, P("shard", None, None))
+        self.block_sharding2 = lay.shardings(mesh, lay.blocked2())
+        self.block_sharding3 = lay.shardings(mesh, lay.blocked3())
 
         # Compact int32 wire formats (engine.REQ32 / pack_resp_compact):
         # per-shard request blocks cross host->devices at 76 B/request and
@@ -138,6 +177,7 @@ class ShardedOps:
             )
 
         def _tick(state_blk, req_blk, now):
+            self.trace_counts["tick"] += 1
             st, resp = tick(state_blk, req_blk[0], now)
             return st, resp[None]
 
@@ -145,6 +185,29 @@ class ShardedOps:
             _tick,
             (state_spec, P("shard", None, None), P()),
             (state_spec, P("shard", None, None)),
+        )
+
+        # ---- Device-routed flat programs (module docstring): one
+        # replicated slot-sorted (19, B) batch in, each shard compacts
+        # its own rows to a narrow (19, local_width) block on device,
+        # and the responses gather collectively with one psum.
+        self.local_width = int(local_width) or local_capacity
+
+        def _tick_routed(state_blk, m, now):
+            self.trace_counts["tick_routed"] += 1
+            my = lax.axis_index("shard")
+            blk, src = route_block(m, my, local_capacity, self.local_width)
+            st, resp = tick(state_blk, blk, now)
+            out = scatter_flat(resp, src, m.shape[1])
+            return st, lax.psum(out, "shard")
+
+        flat_in = (state_spec, lay.flat2(), lay.scalar())
+        self.tick_routed = jax.jit(
+            shard_map(
+                _tick_routed, mesh=mesh, in_specs=flat_in,
+                out_specs=(state_spec, lay.flat2()), check_vma=False,
+            ),
+            donate_argnums=(0,),
         )
 
         # The parts-native program for duplicate-free windows (the
@@ -162,6 +225,7 @@ class ShardedOps:
             tick32 = make_tick32_fn(local_capacity, layout)
 
             def _tick32(state_blk, req_blk, now):
+                self.trace_counts["tick_unique"] += 1
                 st, resp = tick32(state_blk, req_blk[0], now)
                 return st, resp[None]
 
@@ -171,10 +235,29 @@ class ShardedOps:
                 (state_spec, P("shard", None, None)),
             )
             self.stack6 = None
+
+            def _tick32_routed(state_blk, m, now):
+                self.trace_counts["tick_unique_routed"] += 1
+                my = lax.axis_index("shard")
+                blk, src = route_block(
+                    m, my, local_capacity, self.local_width)
+                st, resp = tick32(state_blk, blk, now)
+                return st, lax.psum(
+                    scatter_flat(resp, src, m.shape[1]), "shard")
+
+            self.tick_unique_routed = jax.jit(
+                shard_map(
+                    _tick32_routed, mesh=mesh, in_specs=flat_in,
+                    out_specs=(state_spec, lay.flat2()), check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+            self.stack6_routed = None
         else:
             tick32_rows = make_tick32_rows_fn(local_capacity, layout)
 
             def _tick32(state_blk, req_blk, now):
+                self.trace_counts["tick_unique"] += 1
                 st, rows = tick32_rows(state_blk, req_blk[0], now)
                 return st, tuple(r[None] for r in rows)
 
@@ -184,6 +267,32 @@ class ShardedOps:
                 (state_spec, tuple(P("shard", None) for _ in range(6))),
             )
             self.stack6 = jax.jit(lambda rows: jnp.stack(rows, axis=1))
+
+            def _tick32_routed(state_blk, m, now):
+                self.trace_counts["tick_unique_routed"] += 1
+                my = lax.axis_index("shard")
+                blk, src = route_block(
+                    m, my, local_capacity, self.local_width)
+                st, rows = tick32_rows(state_blk, blk, now)
+                b = m.shape[1]
+                return st, tuple(
+                    lax.psum(scatter_flat(r, src, b), "shard")
+                    for r in rows
+                )
+
+            self.tick_unique_routed = jax.jit(
+                shard_map(
+                    _tick32_routed, mesh=mesh, in_specs=flat_in,
+                    out_specs=(
+                        state_spec, tuple(P(None) for _ in range(6))),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+            # Same second-program stack as the blocked path (stacking
+            # the six rows inside the tick hits the CPU concat-fusion
+            # pathology; see the blocked comment above).
+            self.stack6_routed = jax.jit(lambda rows: jnp.stack(rows, axis=0))
 
         def _evict(state_blk, slots_blk):
             return evict(state_blk, slots_blk[0])
@@ -236,6 +345,15 @@ class ShardedOps:
         state, out = self.tick_unique(state, m_dev, now)
         if self.stack6 is not None:
             out = self.stack6(out)
+        return state, out
+
+    def run_tick_routed_unique(self, state, m_dev, now):
+        """Dispatch the duplicate-free device-routed tick; returns the
+        flat (6, B) response whichever internal format the backend
+        uses."""
+        state, out = self.tick_unique_routed(state, m_dev, now)
+        if self.stack6_routed is not None:
+            out = self.stack6_routed(out)
         return state, out
 
     def put2(self, blk: np.ndarray):
@@ -299,6 +417,56 @@ class MeshTickHandle:
         return self._done, self.errors
 
 
+class MeshRoutedTickHandle:
+    """One dispatched device-routed mesh tick: the flat (6, B) compact
+    response is already in slot-sorted request-batch order (the shards'
+    psum gather put every lane back), so resolution is exactly the
+    single-chip ``TickHandle`` contract — un-permute, rebuild the public
+    (5, n) int64 matrix, run the deferred bookkeeping.  Duck-compatible
+    with ``ops.engine.resolve_ticks`` (same-shape responses stack into
+    one D2H)."""
+
+    __slots__ = ("_engine", "_resp", "_n", "_inv", "errors", "_limit_req",
+                 "_wt_args", "_done", "_flock")
+
+    def __init__(self, engine, resp, n, inv, errors, limit_req, wt_args):
+        self._engine = engine
+        self._resp = resp
+        self._n = n
+        self._inv = inv
+        self.errors = errors
+        # Copied: callers may reuse their ReqColumns buffers between
+        # submit and resolve (the pipelining pattern).
+        self._limit_req = np.array(limit_req[:n], np.int64, copy=True)
+        self._wt_args = wt_args
+        self._done: Optional[np.ndarray] = None
+        self._flock = threading.Lock()
+
+    def _finish(self, raw: np.ndarray) -> None:
+        with self._flock:
+            if self._done is not None:
+                return
+            rm = unpack_resp_compact(
+                raw[:, : self._n][:, self._inv], self._limit_req
+            )
+            eng = self._engine
+            with eng._lock:
+                # This window is resolved: it no longer holds its H2D
+                # staging slab, and later windows' uploads stop counting
+                # it as overlap (metric_h2d_overlapped).
+                eng._inflight = max(0, eng._inflight - 1)
+                eng.metric_over_limit += masked_over_limit(rm, self.errors)
+                if eng.store is not None and self._wt_args is not None:
+                    eng._write_through(*self._wt_args)
+            self._resp = None  # release the device buffer reference
+            self._done = rm
+
+    def result(self):
+        if self._done is None:
+            self._finish(np.asarray(self._resp))
+        return self._done, self.errors
+
+
 class MeshTickEngine:
     """Host driver for the sharded table (multi-chip WorkerPool analog).
 
@@ -307,7 +475,14 @@ class MeshTickEngine:
     sharded across ``mesh``; total capacity is ``n_shards * local_capacity``.
     Key→shard routing reuses the engine's slot allocator: global slot ``g``
     lives on shard ``g // local_capacity`` at local offset
-    ``g % local_capacity``.
+    ``g % local_capacity`` — the ONE ownership rule, derived identically by
+    the host resolve and the on-device router (partition.route_block).
+
+    ``routing`` selects the tick wire format: ``"device"`` (the ``"auto"``
+    default) ships one flat slot-sorted batch and lets each shard compact
+    its own rows on device; ``"host"`` keeps the legacy host-blocked
+    per-shard packing wholesale.  ``local_width`` bounds the routed
+    per-shard block (0 = auto: ~B/n with headroom, 64-lane quantized).
     """
 
     def __init__(
@@ -317,20 +492,43 @@ class MeshTickEngine:
         max_batch: int = 1024,
         store=None,
         table_layout: str = "auto",
+        routing: str = "auto",
+        local_width: int = 0,
     ):
+        from gubernator_tpu.config import env_knob
         from gubernator_tpu.ops.engine import make_slot_map
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.devices.size
         self.local_capacity = int(local_capacity)
         self.capacity = self.n_shards * self.local_capacity
+        if self.capacity >= (1 << 31):
+            # The flat wire format carries GLOBAL slots in an int32 row.
+            raise ValueError(
+                f"sharded table capacity {self.capacity} exceeds int32 "
+                "global slots"
+            )
         self.max_batch = int(max_batch)
         self.store = store
+        if routing not in ("auto", "device", "host"):
+            raise ValueError(f"unknown mesh routing {routing!r}")
+        self.routing = "host" if routing == "host" else "device"
+        if not local_width:
+            # ~B/n with 25% headroom for hash imbalance, 64-lane
+            # quantized; adversarially skewed windows fall back to the
+            # blocked format (metric_routed_overflows).
+            local_width = max(64, -(-5 * self.max_batch
+                                    // (4 * self.n_shards)))
+            local_width = -(-local_width // 64) * 64
+        self.local_width = min(int(local_width), self.max_batch)
         self.layout = make_layout_choice(
             table_layout, self.local_capacity,
             self.mesh.devices.flat[0], self.max_batch,
         )
-        self.ops = ShardedOps(self.mesh, self.local_capacity, self.layout)
+        self.ops = ShardedOps(
+            self.mesh, self.local_capacity, self.layout,
+            local_width=self.local_width,
+        )
         self.state = self.ops.init_state()
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
@@ -343,6 +541,21 @@ class MeshTickEngine:
         self._pending: set = set()
         self._tick_count = 0
         self._lock = threading.RLock()
+        # Flat-upload staging ring + overlap telemetry (the PR 6
+        # double-buffered H2D pipeline, shared via ops.engine.StagingRing;
+        # sentinel is the GLOBAL capacity — flat padding lanes belong to
+        # no shard).
+        try:
+            _depth = max(1, env_knob(
+                "GUBER_TICK_PIPELINE_DEPTH", 4, parse=int))
+        except ValueError:
+            _depth = 4
+        self._staging = StagingRing(REQ32_ROWS, self.capacity, 2 * _depth + 1)
+        self._inflight = 0
+        self.metric_h2d_windows = 0
+        self.metric_h2d_overlapped = 0
+        self.metric_routed_windows = 0
+        self.metric_routed_overflows = 0
         self.metric_hits = 0
         self.metric_misses = 0
         self.metric_over_limit = 0
@@ -350,19 +563,40 @@ class MeshTickEngine:
         self._warmup()
 
     def _warmup(self) -> None:
-        """Compile the sharded tick at startup (see TickEngine._warmup)."""
-        m = np.zeros((self.n_shards, REQ32_ROWS, self.max_batch), np.int32)
-        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
-        # Warm both programs: the merge-capable x64 tick and the
-        # duplicate-free parts tick.
-        self.state, resp = self.ops.tick(
-            self.state, self.ops.put3(m), jnp.int64(0)
-        )
-        np.asarray(resp)  # warm the response D2H path (see TickEngine._warmup)
-        self.state, resp = self.ops.run_tick_unique(
-            self.state, self.ops.put3(m), jnp.int64(0)
-        )
-        np.asarray(resp)
+        """Compile the serving-path programs at startup (see
+        TickEngine._warmup).  Only the selected routing mode's tick pair
+        warms — the other mode compiles lazily on first use (the
+        blocked pair still serves as the routed path's skew fallback).
+        Warmup MUST dispatch with the exact serving signature:
+        ``jnp.asarray`` uploads (uncommitted), never a committed
+        ``device_put`` — a committed sharding is a new jit signature
+        that re-traces every warmed program (~0.6 s each; the
+        ShardedOps.trace_counts pin in test_mesh_engine holds this)."""
+        if self.routing == "device":
+            m = np.zeros((REQ32_ROWS, self.max_batch), np.int32)
+            m[REQ32_INDEX["slot"]] = self.capacity
+            self.state, resp = self.ops.tick_routed(
+                self.state, jnp.asarray(m), jnp.int64(0)
+            )
+            np.asarray(resp)  # warm the response D2H path
+            self.state, resp = self.ops.run_tick_routed_unique(
+                self.state, jnp.asarray(m), jnp.int64(0)
+            )
+            np.asarray(resp)
+        else:
+            mb = np.zeros((self.n_shards, REQ32_ROWS, self.max_batch),
+                          np.int32)
+            mb[:, REQ32_INDEX["slot"], :] = self.local_capacity
+            # Warm both programs: the merge-capable x64 tick and the
+            # duplicate-free parts tick.
+            self.state, resp = self.ops.tick(
+                self.state, self.ops.put3(mb), jnp.int64(0)
+            )
+            np.asarray(resp)
+            self.state, resp = self.ops.run_tick_unique(
+                self.state, self.ops.put3(mb), jnp.int64(0)
+            )
+            np.asarray(resp)
         cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
         self.state = self.ops.install(
             self.state, self.ops.put3(cols), jnp.int64(0)
@@ -370,6 +604,11 @@ class MeshTickEngine:
         # Pre-compile the per-shard reclaim dead-scan (see TickEngine).
         self._shard_dead_mask(0, 0)
         jax.block_until_ready(self.state)
+
+    def h2d_overlap_ratio(self) -> float:
+        """Fraction of serving windows whose request upload overlapped an
+        earlier window's still-running tick (see TickEngine)."""
+        return self.metric_h2d_overlapped / max(1, self.metric_h2d_windows)
 
     # ------------------------------------------------------------------
     # Shard routing / reclamation
@@ -455,23 +694,140 @@ class MeshTickEngine:
     # uniform across however many shards exist: workers.go:125-147)
     # ------------------------------------------------------------------
     @hot_path
-    def submit_columns(
-        self, cols, now: Optional[int] = None
-    ) -> "MeshTickHandle":
-        """Build + dispatch one blocked mesh tick (≤ max_batch rows) and
-        return a handle; device work is queued, not awaited, so host
-        packing of the next tick overlaps device execution of this one
-        (TickEngine.submit_columns's contract, shard-blocked).
+    def _gregorian_cols(self, cols, now: int, errors: Dict[int, str]):
+        """Host-side Gregorian resolution (flagged rows only)."""
+        n = len(cols)
+        GREG = int(Behavior.DURATION_IS_GREGORIAN)
+        greg_e = np.zeros(n, np.int64)
+        greg_d = np.zeros(n, np.int64)
+        greg = cols.behavior & GREG
+        if greg.any():
+            for i in np.flatnonzero(greg):
+                try:
+                    d = int(cols.duration[i])
+                    greg_e[i] = timeutil.gregorian_expiration(now, d)
+                    greg_d[i] = timeutil.gregorian_duration(now, d)
+                except timeutil.GregorianError as exc:
+                    errors[int(i)] = str(exc)
+        return greg_e, greg_d
 
-        Host path is fully vectorized: one native CRC-32 batch routes
-        keys to shards, per-shard native blob resolves assign slots, one
-        argsort by (shard, slot) establishes each shard's sorted-input
-        contract, and every request-matrix row is one fancy-indexed
-        numpy write.  Keys whose shard stays full after reclaim become
-        per-item errors (the reference's error-in-item convention)."""
+    @hot_path
+    def _resolve_columns(self, cols, now: int, errors: Dict[int, str]):
+        """The sharded-slotmap resolve: one vectorized CRC-32 batch
+        routes keys to shards (bit-identical to the scalar ``_shard_of``
+        router — and to the ownership the device derives from the
+        resulting global slot), the key blob regroups by shard with one
+        byte-gather, and one native blob resolve per shard assigns local
+        slots, reclaiming on pressure.  Keys whose shard stays full
+        after reclaim become per-item errors (the reference's
+        error-in-item convention).  Returns ``(sh, slots, known)`` with
+        resolved rows stamped live (``_last_access``/``_pending``)."""
         from gubernator_tpu.native import crc32_batch
-        from gubernator_tpu.ops.reqcols import ReqColumns
 
+        n = len(cols)
+        # Key → shard (vectorized CRC-32 over the packed key blob).
+        sh = (
+            crc32_batch(cols.key_blob, cols.key_offsets)
+            % np.uint32(self.n_shards)
+        ).astype(np.int64)
+
+        order = np.argsort(sh, kind="stable")
+        # guber: allow-G001(key_offsets is host numpy, never device)
+        offs = np.asarray(cols.key_offsets, np.int64)
+        lens = np.diff(offs)
+        lo = lens[order]
+        so = offs[:-1][order]
+        cum = np.cumsum(lo)
+        blob_arr = np.frombuffer(cols.key_blob, np.uint8)
+        if len(blob_arr):
+            gather = (
+                np.arange(int(cum[-1]), dtype=np.int64)
+                - np.repeat(cum - lo, lo)
+                + np.repeat(so, lo)
+            )
+            grouped_blob = blob_arr[gather].tobytes()
+        else:
+            grouped_blob = b""
+        g_offsets = np.concatenate(
+            [np.zeros(1, np.int64), cum]
+        )
+        shard_sorted = sh[order]
+        starts = np.searchsorted(shard_sorted, np.arange(self.n_shards + 1))
+
+        slots = np.full(n, -1, np.int64)
+        known = np.zeros(n, np.uint8)
+        for s in range(self.n_shards):
+            a, z = int(starts[s]), int(starts[s + 1])
+            if a == z:
+                continue
+            rows_s = order[a:z]
+            off_s = g_offsets[a:z + 1] - g_offsets[a]
+            blob_s = grouped_blob[g_offsets[a]:g_offsets[z]]
+            sm = self.slots[s]
+            sl, kn = sm.resolve_blob(blob_s, off_s)
+            if (sl < 0).any():
+                # Stamp already-resolved rows live before reclaiming
+                # (an unstamped reclaim could hand a just-resolved
+                # slot to the retried keys).
+                okm = sl >= 0
+                g = s * self.local_capacity + sl[okm]
+                self._last_access[g] = self._tick_count
+                self._pending.update(g[kn[okm] == 0].tolist())
+                self._reclaim(s, now)
+                retry = np.flatnonzero(sl < 0)
+                s2, k2 = sm.resolve_batch(
+                    [cols.key_bytes(int(rows_s[t])) for t in retry])
+                sl[retry] = s2
+                kn[retry] = k2
+                for t in np.flatnonzero(sl < 0):
+                    errors[int(rows_s[t])] = (
+                        "rate-limit shard full; eviction failed")
+            slots[rows_s] = sl
+            known[rows_s] = kn
+
+        resolved = slots >= 0
+        g_res = sh[resolved] * self.local_capacity + slots[resolved]
+        self._last_access[g_res] = self._tick_count
+        self._pending.update(g_res[known[resolved] == 0].tolist())
+        return sh, slots, known
+
+    @hot_path
+    def _account_misses(self, cols, sh, slots, known, now: int) -> None:
+        """Hit/miss accounting + Store read-through for one resolved
+        batch (``known`` is updated in place for store-restored rows)."""
+        n = len(cols)
+        resolved = slots >= 0
+        miss_like = resolved & (known == 0)
+        if self.store is not None and self._pending:
+            g_all = sh * self.local_capacity + np.maximum(slots, 0)
+            pend = self._pending
+            miss_like = miss_like | (resolved & np.fromiter(
+                (int(g) in pend for g in g_all), np.bool_, n))
+        n_res = int(resolved.sum())
+        n_miss = int(miss_like.sum())
+        self.metric_hits += n_res - n_miss
+        self.metric_misses += n_miss
+        if self.store is not None and n_miss:
+            if cols.refs is None:
+                raise ValueError(
+                    "Store read-through needs request objects; build "
+                    "the batch with ReqColumns.from_requests(..., "
+                    "keep_refs=True)")
+            self._read_through(
+                cols.refs, list(range(n)), sh, slots, known,
+                np.flatnonzero(miss_like), now)
+
+    @hot_path
+    def submit_columns(self, cols, now: Optional[int] = None):
+        """Build + dispatch one mesh tick (≤ max_batch rows) and return
+        a handle; device work is queued, not awaited, so host packing of
+        the next tick overlaps device execution of this one
+        (TickEngine.submit_columns's contract, sharded).
+
+        The resolve is shared; the wire format is per ``routing``: the
+        device-routed flat dispatch when every shard's row count fits
+        its ``local_width`` block, the host-blocked dispatch for skewed
+        windows and for ``routing="host"`` engines."""
         n = len(cols)
         if n > self.max_batch:
             raise ValueError(
@@ -480,181 +836,152 @@ class MeshTickEngine:
             now = now if now is not None else timeutil.now_ms()
             self._tick_count += 1
             errors: Dict[int, str] = {}
-
-            # Host-side Gregorian resolution (flagged rows only).
-            GREG = int(Behavior.DURATION_IS_GREGORIAN)
-            greg_e = np.zeros(n, np.int64)
-            greg_d = np.zeros(n, np.int64)
-            greg = cols.behavior & GREG
-            if greg.any():
-                for i in np.flatnonzero(greg):
-                    try:
-                        d = int(cols.duration[i])
-                        greg_e[i] = timeutil.gregorian_expiration(now, d)
-                        greg_d[i] = timeutil.gregorian_duration(now, d)
-                    except timeutil.GregorianError as exc:
-                        errors[int(i)] = str(exc)
-
-            # Key → shard (vectorized CRC-32 over the packed key blob,
-            # bit-identical to the scalar _shard_of router).
-            sh = (
-                crc32_batch(cols.key_blob, cols.key_offsets)
-                % np.uint32(self.n_shards)
-            ).astype(np.int64)
-
-            # Per-shard native resolve: regroup the key blob by shard
-            # with one byte-gather, then one resolve_blob per shard.
-            order = np.argsort(sh, kind="stable")
-            # guber: allow-G001(key_offsets is host numpy, never device)
-            offs = np.asarray(cols.key_offsets, np.int64)
-            lens = np.diff(offs)
-            lo = lens[order]
-            so = offs[:-1][order]
-            cum = np.cumsum(lo)
-            blob_arr = np.frombuffer(cols.key_blob, np.uint8)
-            if len(blob_arr):
-                gather = (
-                    np.arange(int(cum[-1]), dtype=np.int64)
-                    - np.repeat(cum - lo, lo)
-                    + np.repeat(so, lo)
-                )
-                grouped_blob = blob_arr[gather].tobytes()
-            else:
-                grouped_blob = b""
-            g_offsets = np.concatenate(
-                [np.zeros(1, np.int64), cum]
-            )
-            shard_sorted = sh[order]
-            starts = np.searchsorted(shard_sorted, np.arange(self.n_shards + 1))
-
-            slots = np.full(n, -1, np.int64)
-            known = np.zeros(n, np.uint8)
-            for s in range(self.n_shards):
-                a, z = int(starts[s]), int(starts[s + 1])
-                if a == z:
-                    continue
-                rows_s = order[a:z]
-                off_s = g_offsets[a:z + 1] - g_offsets[a]
-                blob_s = grouped_blob[g_offsets[a]:g_offsets[z]]
-                sm = self.slots[s]
-                sl, kn = sm.resolve_blob(blob_s, off_s)
-                if (sl < 0).any():
-                    # Stamp already-resolved rows live before reclaiming
-                    # (an unstamped reclaim could hand a just-resolved
-                    # slot to the retried keys).
-                    okm = sl >= 0
-                    g = s * self.local_capacity + sl[okm]
-                    self._last_access[g] = self._tick_count
-                    self._pending.update(g[kn[okm] == 0].tolist())
-                    self._reclaim(s, now)
-                    retry = np.flatnonzero(sl < 0)
-                    s2, k2 = sm.resolve_batch(
-                        [cols.key_bytes(int(rows_s[t])) for t in retry])
-                    sl[retry] = s2
-                    kn[retry] = k2
-                    for t in np.flatnonzero(sl < 0):
-                        errors[int(rows_s[t])] = (
-                            "rate-limit shard full; eviction failed")
-                slots[rows_s] = sl
-                known[rows_s] = kn
-
-            resolved = slots >= 0
-            g_res = sh[resolved] * self.local_capacity + slots[resolved]
-            self._last_access[g_res] = self._tick_count
-            self._pending.update(g_res[known[resolved] == 0].tolist())
-
-            miss_like = resolved & (known == 0)
-            if self.store is not None and self._pending:
-                g_all = sh * self.local_capacity + np.maximum(slots, 0)
-                pend = self._pending
-                miss_like = miss_like | (resolved & np.fromiter(
-                    (int(g) in pend for g in g_all), np.bool_, n))
-            n_res = int(resolved.sum())
-            n_miss = int(miss_like.sum())
-            self.metric_hits += n_res - n_miss
-            self.metric_misses += n_miss
-            if self.store is not None and n_miss:
-                if cols.refs is None:
-                    raise ValueError(
-                        "Store read-through needs request objects; build "
-                        "the batch with ReqColumns.from_requests(..., "
-                        "keep_refs=True)")
-                self._read_through(
-                    cols.refs, list(range(n)), sh, slots, known,
-                    np.flatnonzero(miss_like), now)
-
-            # Per-shard sorted-input contract: one argsort by
-            # (shard, slot); error rows sort to each shard's end.
-            safe_slots = np.where(resolved, slots, self.local_capacity)
-            key = sh * (self.local_capacity + 1) + safe_slots
-            order2 = np.argsort(key, kind="stable")
-            sh2 = sh[order2]
-            pos_sorted = np.arange(n, dtype=np.int64) - np.searchsorted(
-                sh2, np.arange(self.n_shards + 1))[sh2]
-            ps = np.full(n, -1, np.int64)
-            ps[order2] = pos_sorted
-
-            w = self.max_batch
-            m = np.zeros((self.n_shards, REQ32_ROWS, w), np.int32)
-            m[:, REQ32_INDEX["slot"], :] = self.local_capacity
-            R = REQ32_INDEX
-            ok = resolved.copy()
+            greg_e, greg_d = self._gregorian_cols(cols, now, errors)
+            sh, slots, known = self._resolve_columns(cols, now, errors)
+            self._account_misses(cols, sh, slots, known, now)
+            ok = slots >= 0
             for i in errors:
                 ok[i] = False
-            ix = np.flatnonzero(ok)
-            nodes, sel_ps = sh[ix], ps[ix]
-            m[nodes, R["slot"], sel_ps] = slots[ix]
-            m[nodes, R["known"], sel_ps] = known[ix]
-            m[nodes, R["algorithm"], sel_ps] = cols.algorithm[ix]
-            m[nodes, R["behavior"], sel_ps] = cols.behavior[ix]
-            m[nodes, R["valid"], sel_ps] = 1
-
-            def put_wide(name, vals):
-                lo32, hi32 = split_i64(np.asarray(vals, np.int64))
-                r = R[name]
-                m[nodes, r, sel_ps] = lo32
-                m[nodes, r + 1, sel_ps] = hi32
-
-            put_wide("hits", cols.hits[ix])
-            put_wide("limit", cols.limit[ix])
-            put_wide("duration", cols.duration[ix])
-            ca = cols.created_at[ix]
-            put_wide("created_at", np.where(ca != CREATED_UNSET, ca, now))
-            put_wide("burst", cols.burst[ix])
-            put_wide("greg_exp", greg_e[ix])
-            put_wide("greg_dur", greg_d[ix])
-
-            # Duplicate-free windows (adjacent-equal check on the sort
-            # key already built for order2) dispatch the parts-native
-            # program — the fused Mosaic kernel per shard on the row
-            # layout; duplicate-bearing windows keep the merge-capable
-            # x64 program wholesale (cross-member sequencing).
-            key_sorted = key[order2]
-            slots_sorted = safe_slots[order2]
-            # guber: allow-G001(sort keys are host numpy, never device)
-            has_dups = bool(np.any(
-                (key_sorted[1:] == key_sorted[:-1])
-                & (slots_sorted[1:] < self.local_capacity)
-            ))
-            if has_dups:
-                self.state, resp = self.ops.tick(
-                    self.state, self.ops.put3(m), jnp.int64(now)
-                )
-            else:
-                self.state, resp = self.ops.run_tick_unique(
-                    self.state, self.ops.put3(m), jnp.int64(now)
-                )
-            self._pending.clear()
-            wt_args = None
-            if self.store is not None:
-                wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
-            handle = MeshTickHandle(
-                self, resp, n, sh, np.where(ok, ps, -1), errors,
-                limit_req=cols.limit, wt_args=wt_args,
+            if self.routing == "device":
+                counts = np.bincount(
+                    sh[ok], minlength=self.n_shards
+                ) if ok.any() else np.zeros(self.n_shards, np.int64)
+                if counts.max(initial=0) <= self.local_width:
+                    return self._dispatch_routed(
+                        cols, now, sh, slots, known, ok,
+                        greg_e, greg_d, errors,
+                    )
+                self.metric_routed_overflows += 1
+            return self._dispatch_blocked(
+                cols, now, sh, slots, known, ok, greg_e, greg_d, errors,
             )
-            if self.store is not None:
-                handle.result()
-            return handle
+
+    @hot_path
+    def _dispatch_routed(
+        self, cols, now, sh, slots, known, ok, greg_e, greg_d, errors
+    ) -> "MeshRoutedTickHandle":
+        """The flat device-routed dispatch: pack ONE slot-sorted
+        (19, B) compact matrix carrying GLOBAL slots into a leased
+        staging slab, upload it with an async ``jnp.asarray`` copy (the
+        transfer rides under the previous window's tick; the uncommitted
+        signature matches warmup, so re-dispatch reuses the compiled
+        program), and let every shard compact its own rows on device —
+        no per-shard host loop, responses gathered with one psum."""
+        n = len(cols)
+        b = self.max_batch
+        m = self._staging.lease(b)
+        ix = np.flatnonzero(ok)
+        gslot = sh[ix] * self.local_capacity + slots[ix]
+        pack_cols_req32(m, cols, gslot, known[ix], now, ix)
+        pack_wide_rows(m, "greg_exp", greg_e[ix], ix)
+        pack_wide_rows(m, "greg_dur", greg_d[ix], ix)
+        inv, has_dups = sort_packed_by_slot(m, n, self.capacity)
+        dev_m = jnp.asarray(m)
+        if has_dups:
+            self.state, resp = self.ops.tick_routed(
+                self.state, dev_m, jnp.int64(now)
+            )
+        else:
+            self.state, resp = self.ops.run_tick_routed_unique(
+                self.state, dev_m, jnp.int64(now)
+            )
+        self._pending.clear()
+        self.metric_routed_windows += 1
+        wt_args = None
+        if self.store is not None:
+            wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
+        handle = MeshRoutedTickHandle(
+            self, resp, n, inv, errors, cols.limit, wt_args
+        )
+        self.metric_h2d_windows += 1
+        if self._inflight > 0:
+            self.metric_h2d_overlapped += 1
+        self._inflight += 1
+        self._staging.retire(handle)
+        if self.store is not None:
+            handle.result()
+        return handle
+
+    @hot_path
+    def _dispatch_blocked(
+        self, cols, now, sh, slots, known, ok, greg_e, greg_d, errors
+    ) -> "MeshTickHandle":
+        """The legacy host-blocked dispatch: one argsort by
+        (shard, slot) establishes each shard's sorted-input contract,
+        every request-matrix row is one fancy-indexed numpy write into
+        the (n_shards, 19, W) block matrix, committed ``device_put``
+        places it per shard."""
+        n = len(cols)
+        resolved = slots >= 0
+        # Per-shard sorted-input contract: one argsort by
+        # (shard, slot); error rows sort to each shard's end.
+        safe_slots = np.where(resolved, slots, self.local_capacity)
+        key = sh * (self.local_capacity + 1) + safe_slots
+        order2 = np.argsort(key, kind="stable")
+        sh2 = sh[order2]
+        pos_sorted = np.arange(n, dtype=np.int64) - np.searchsorted(
+            sh2, np.arange(self.n_shards + 1))[sh2]
+        ps = np.full(n, -1, np.int64)
+        ps[order2] = pos_sorted
+
+        w = self.max_batch
+        m = np.zeros((self.n_shards, REQ32_ROWS, w), np.int32)
+        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
+        R = REQ32_INDEX
+        ix = np.flatnonzero(ok)
+        nodes, sel_ps = sh[ix], ps[ix]
+        m[nodes, R["slot"], sel_ps] = slots[ix]
+        m[nodes, R["known"], sel_ps] = known[ix]
+        m[nodes, R["algorithm"], sel_ps] = cols.algorithm[ix]
+        m[nodes, R["behavior"], sel_ps] = cols.behavior[ix]
+        m[nodes, R["valid"], sel_ps] = 1
+
+        def put_wide(name, vals):
+            lo32, hi32 = split_i64(np.asarray(vals, np.int64))
+            r = R[name]
+            m[nodes, r, sel_ps] = lo32
+            m[nodes, r + 1, sel_ps] = hi32
+
+        put_wide("hits", cols.hits[ix])
+        put_wide("limit", cols.limit[ix])
+        put_wide("duration", cols.duration[ix])
+        ca = cols.created_at[ix]
+        put_wide("created_at", np.where(ca != CREATED_UNSET, ca, now))
+        put_wide("burst", cols.burst[ix])
+        put_wide("greg_exp", greg_e[ix])
+        put_wide("greg_dur", greg_d[ix])
+
+        # Duplicate-free windows (adjacent-equal check on the sort
+        # key already built for order2) dispatch the parts-native
+        # program — the fused Mosaic kernel per shard on the row
+        # layout; duplicate-bearing windows keep the merge-capable
+        # x64 program wholesale (cross-member sequencing).
+        key_sorted = key[order2]
+        slots_sorted = safe_slots[order2]
+        # guber: allow-G001(sort keys are host numpy, never device)
+        has_dups = bool(np.any(
+            (key_sorted[1:] == key_sorted[:-1])
+            & (slots_sorted[1:] < self.local_capacity)
+        ))
+        if has_dups:
+            self.state, resp = self.ops.tick(
+                self.state, self.ops.put3(m), jnp.int64(now)
+            )
+        else:
+            self.state, resp = self.ops.run_tick_unique(
+                self.state, self.ops.put3(m), jnp.int64(now)
+            )
+        self._pending.clear()
+        wt_args = None
+        if self.store is not None:
+            wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
+        handle = MeshTickHandle(
+            self, resp, n, sh, np.where(ok, ps, -1), errors,
+            limit_req=cols.limit, wt_args=wt_args,
+        )
+        if self.store is not None:
+            handle.result()
+        return handle
 
     @hot_path
     def submit_cols(self, cols, now: Optional[int] = None):
@@ -964,6 +1291,44 @@ class MeshTickEngine:
                 self.state = self.ops.restore(
                     self.state, self.ops.put3(ints), self.ops.put2(floats)
                 )
+
+    def routing_parity_errors(self, keys: Sequence[str]) -> int:
+        """Audit key→shard routing parity for ``keys`` (post-serving):
+        the vectorized CRC-32 route, the scalar ``_shard_of`` host ring,
+        and actual slotmap residency must all agree, each resident key
+        must live on exactly ONE shard (a key mapped on two shards is a
+        double-serve; on zero shards after serving, a drop), and its
+        global slot must derive back to the owning shard — the exact
+        invariant the device router applies (``slot // local_capacity``).
+        Returns the number of keys violating any of these; the bench
+        mesh rungs export it as ``mesh_routing_parity_errors`` and CI
+        gates it at exactly 0."""
+        from gubernator_tpu.native import crc32_batch
+
+        enc = [k.encode() for k in keys]
+        blob = b"".join(enc)
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(e) for e in enc], out=offsets[1:])
+        vec = (
+            crc32_batch(blob, offsets) % np.uint32(self.n_shards)
+        ).astype(np.int64)
+        errs = 0
+        with self._lock:
+            for i, k in enumerate(keys):
+                s = self._shard_of(k)
+                owners = [
+                    d for d in range(self.n_shards)
+                    if self.slots[d].get(k) is not None
+                ]
+                if int(vec[i]) != s or owners != [s]:
+                    errs += 1
+                    continue
+                local = self.slots[s].get(k)
+                g = s * self.local_capacity + local
+                if not (0 <= local < self.local_capacity) or \
+                        g // self.local_capacity != s:
+                    errs += 1
+        return errs
 
     def cache_size(self) -> int:
         return sum(len(sm) for sm in self.slots)

@@ -1,0 +1,174 @@
+"""Canonical PartitionSpec layouts for the sharded serving path.
+
+One frozen spec-helper per mesh axis family — the SNIPPETS [3] idiom
+(a ``SpecLayout`` dataclass whose methods name every placement a
+subsystem uses) applied to the bucket table instead of transformer
+parameters.  Every ``PartitionSpec`` the sharded tick engine
+(:mod:`gubernator_tpu.parallel.mesh_engine`) and the GLOBAL collectives
+engine (:mod:`gubernator_tpu.parallel.global_mesh`) place data with is
+minted HERE, so the two engines can never drift on what "sharded over
+the table axis" or "one replica row per node" means, and a reviewer can
+read the whole placement story in one file:
+
+* :class:`ShardLayout` — the partitioned serving table.  The SoA bucket
+  state is split over the 1-D ``('shard',)`` mesh by contiguous slot
+  range (device *d* owns global slots ``[d*local_cap, (d+1)*local_cap)``);
+  request/response blocks are either *blocked* (leading shard axis, the
+  host-routed legacy format) or *flat replicated* (the device-routed
+  format — one (19, B) matrix broadcast to every shard, each shard
+  compacting its own rows on device).
+* :class:`NodeLayout` — the replicated GLOBAL table.  One replica row
+  per node (``P('node', None)``), accumulator/aux matrices alongside,
+  scalars replicated.
+
+The device-side routing kernels live here too (:func:`route_block`,
+:func:`scatter_flat`): they are pure functions of the replicated flat
+request matrix and the shard index, shared by every routed program the
+mesh engine builds, and their contract (global-slot ownership derived
+as ``slot // local_capacity`` — nothing else) IS the on-device routing
+design: the host never regroups requests per shard, and the response
+fan-in is one ``psum``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.ops.buckets import BucketState
+from gubernator_tpu.ops.engine import REQ32_INDEX
+from gubernator_tpu.ops.rowtable import RowState
+
+
+@dataclass(frozen=True)
+class ShardLayout:
+    """Canonical PartitionSpecs for the slot-partitioned serving table
+    (the ``('shard',)`` mesh of :func:`mesh_engine.make_mesh`)."""
+
+    shard_axis: str = "shard"
+
+    def table_spec(self, layout: str):
+        """Spec tree for the bucket table in storage layout ``layout``:
+        every column (or the row table's leading axis) splits over the
+        shard axis by contiguous slot range."""
+        if layout == "row":
+            return RowState(table=P(self.shard_axis, None))
+        return jax.tree.map(lambda _: P(self.shard_axis), BucketState.zeros(0))
+
+    def blocked2(self) -> P:
+        """(n_shards, W) host-blocked matrix: one row block per shard."""
+        return P(self.shard_axis, None)
+
+    def blocked3(self) -> P:
+        """(n_shards, ROWS, W) host-blocked request/column matrix."""
+        return P(self.shard_axis, None, None)
+
+    def flat2(self) -> P:
+        """(ROWS, B) device-routed flat request matrix — replicated to
+        every shard; each device compacts its own rows on device."""
+        return P(None, None)
+
+    def scalar(self) -> P:
+        """Replicated scalar (``now`` stamps, flags)."""
+        return P()
+
+    def shardings(self, mesh: Mesh, spec_tree):
+        """NamedShardings for a spec tree (or a bare spec) on ``mesh``.
+        PartitionSpec is a tuple subclass, so tree traversal must treat
+        it as a leaf."""
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+
+@dataclass(frozen=True)
+class NodeLayout:
+    """Canonical PartitionSpecs for the replicated GLOBAL table (the
+    ``('node',)`` mesh of :func:`global_mesh.make_global_mesh`): one
+    replica row per node, reconciled with psum collectives only —
+    nothing in this layout ever materializes densely on the host."""
+
+    node_axis: str = "node"
+
+    def replica_spec(self):
+        """Spec tree for the per-node replica rows of the GLOBAL bucket
+        table: (n_nodes, capacity) per column."""
+        return jax.tree.map(
+            lambda _: P(self.node_axis, None), BucketState.zeros(0)
+        )
+
+    def mat3(self) -> P:
+        """(n_nodes, ROWS, capacity) per-node matrix (aux/accumulators/
+        request blocks)."""
+        return P(self.node_axis, None, None)
+
+    def scalar(self) -> P:
+        return P()
+
+    def shardings(self, mesh: Mesh, spec_tree):
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+
+
+# ----------------------------------------------------------------------
+# Device-side routing (traced; called inside the mesh engine's shard_map
+# programs).  The flat request matrix carries GLOBAL slots in its slot
+# row; ownership is derived from the slot value alone.
+# ----------------------------------------------------------------------
+def route_block(m: jnp.ndarray, my: jnp.ndarray, local_capacity: int,
+                local_width: int):
+    """Compact this shard's rows out of the replicated flat batch.
+
+    ``m`` is the (REQ32_ROWS, B) compact request matrix, slot row
+    carrying GLOBAL slots (padding/error lanes carry the global
+    capacity sentinel and belong to no shard).  Returns ``(blk, src)``:
+
+    * ``blk`` — the shard's (REQ32_ROWS, local_width) LOCAL request
+      block: slot row rebased to ``[0, local_capacity)``, guard-padded
+      (slot = local_capacity, valid = 0) past this shard's row count.
+      Host-side slot-sorted order is preserved by the stable compaction,
+      so the per-shard sorted-input tick contract holds for free.
+    * ``src`` — the (local_width,) response scatter map: local lane p's
+      response belongs at flat lane ``src[p]``; unfilled lanes aim one
+      past the batch and drop.
+
+    The host guarantees per-shard row counts fit ``local_width`` (it
+    knows the counts before dispatch and falls back to the blocked
+    format otherwise), so the compaction never truncates live rows.
+    """
+    R = REQ32_INDEX
+    slot_g = m[R["slot"]]
+    valid = m[R["valid"]] != 0
+    b = slot_g.shape[0]
+    lo = my.astype(slot_g.dtype) * local_capacity
+    mine = valid & (slot_g >= lo) & (slot_g < lo + local_capacity)
+    pos = jnp.cumsum(mine.astype(jnp.int32)) - 1
+    tgt = jnp.where(mine, pos, local_width)
+    local = m.at[R["slot"]].set(
+        jnp.where(mine, slot_g - lo, local_capacity).astype(m.dtype)
+    )
+    local = local.at[R["valid"]].set(mine.astype(m.dtype))
+    blk = jnp.zeros((m.shape[0], local_width), m.dtype)
+    blk = blk.at[R["slot"]].set(local_capacity)
+    blk = blk.at[:, tgt].set(local, mode="drop")
+    src = jnp.full(local_width, b, jnp.int32).at[tgt].set(
+        jnp.arange(b, dtype=jnp.int32), mode="drop"
+    )
+    return blk, src
+
+
+def scatter_flat(resp: jnp.ndarray, src: jnp.ndarray, b: int) -> jnp.ndarray:
+    """Scatter a shard's (ROWS, local_width) response block to its flat
+    lanes: the per-shard half of the collective response gather (the
+    cross-shard half is one ``psum`` — rows no shard owns stay zero)."""
+    out = jnp.zeros(resp.shape[:-1] + (b,), resp.dtype)
+    if resp.ndim == 1:
+        return out.at[src].set(resp, mode="drop")
+    return out.at[:, src].set(resp, mode="drop")

@@ -106,6 +106,8 @@ class TickLoop:
         self._synced_promotions = 0
         self._synced_demotions = 0
         self._synced_shed = 0
+        self._synced_routed = 0
+        self._synced_routed_overflows = 0
         self._cond = threading.Condition()
         self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
@@ -375,6 +377,16 @@ class TickLoop:
             m.hot_occupancy.set(self.engine.hot_occupancy())
         if hasattr(self.engine, "h2d_overlap_ratio"):
             m.h2d_overlap_ratio.set(self.engine.h2d_overlap_ratio())
+        # Sharded-table routing telemetry (mesh-backed engines only).
+        routed = getattr(self.engine, "metric_routed_windows", 0)
+        if routed > self._synced_routed:
+            m.mesh_routed_windows.inc(routed - self._synced_routed)
+            self._synced_routed = routed
+        r_over = getattr(self.engine, "metric_routed_overflows", 0)
+        if r_over > self._synced_routed_overflows:
+            m.mesh_routed_overflows.inc(
+                r_over - self._synced_routed_overflows)
+            self._synced_routed_overflows = r_over
 
     def _drain_resolve_q(self, err: Exception) -> None:
         """Fail every window still queued for resolution.  A drained None

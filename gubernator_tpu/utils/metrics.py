@@ -202,6 +202,22 @@ class Metrics:
             "fell back to the dense program.",
             registry=reg,
         )
+        # Sharded serving table (parallel/mesh_engine.py): the
+        # device-routed flat tick is the serving format; a sustained
+        # overflow rate means hash skew keeps exceeding the routed
+        # per-shard block (raise GUBER_MESH_LOCAL_WIDTH).
+        self.mesh_routed_windows = Counter(
+            "gubernator_tpu_mesh_routed_windows",
+            "Serving windows dispatched through the device-routed flat "
+            "tick (each shard compacts its own rows on device).",
+            registry=reg,
+        )
+        self.mesh_routed_overflows = Counter(
+            "gubernator_tpu_mesh_routed_overflows",
+            "Serving windows that exceeded the routed per-shard block "
+            "width and fell back to host-blocked packing for that tick.",
+            registry=reg,
+        )
 
         # Tiered bucket state (docs/tiering.md): demote/promote traffic
         # between the device table and the host-side cold store, tier

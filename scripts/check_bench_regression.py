@@ -44,6 +44,14 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   ownership_transfer_loss        0   — a set_peers ring swap hands owned
 #                                        GLOBAL state to the new owner with
 #                                        no reset (ownership handoff)
+#   mesh_routing_parity_errors     0   — device-derived shard ownership
+#                                        (global slot // local_capacity)
+#                                        agrees with the host hash ring
+#                                        for every served key (a split
+#                                        route double-serves a bucket)
+#   mesh_dropped_keys /            0   — every decision issued to the
+#   mesh_double_served                   sharded table resolves exactly
+#                                        once (issued == hits+misses)
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -52,6 +60,9 @@ COUNT_KEYS = (
     "hit_redelivery_loss",
     "restart_state_loss",
     "ownership_transfer_loss",
+    "mesh_routing_parity_errors",
+    "mesh_dropped_keys",
+    "mesh_double_served",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -72,8 +83,14 @@ LOWER_BETTER_SLACK = {
 #                           upload overlapped an earlier window's tick
 #                           — HIGHER is better; candidate must keep
 #                           >= 0.9x the baseline's ratio...
+#   mesh_scaling_efficiency 8-dev mesh throughput / (8 x the 1-dev mesh
+#                           baseline measured in the same child — the
+#                           near-linear-scaling observable of the
+#                           sharded serving table; HIGHER is better,
+#                           candidate must keep >= 0.9x the baseline
 HIGHER_BETTER_FLOOR = {
     "h2d_overlap_ratio": 0.9,
+    "mesh_scaling_efficiency": 0.9,
 }
 # ...and, baseline or not, a pipelined dispatch that stops overlapping
 # at all is a regression in its own right: absolute floor on the
@@ -95,6 +112,9 @@ ABSOLUTE_ZERO_KEYS = (
     "hit_redelivery_loss",
     "restart_state_loss",
     "ownership_transfer_loss",
+    "mesh_routing_parity_errors",
+    "mesh_dropped_keys",
+    "mesh_double_served",
 )
 
 

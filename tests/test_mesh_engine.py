@@ -99,14 +99,17 @@ def test_mesh_snapshot_roundtrip():
 
 
 def test_matches_single_device_engine():
-    """The sharded tick must agree with the single-chip engine bit-for-bit."""
+    """The sharded tick must agree with the single-chip engine bit-for-bit
+    — including same-tick duplicate keys: both engines sequence same-slot
+    requests in arrival order (stable slot sorts on both paths), so even
+    duplicate-bearing windows must match decision for decision."""
     from gubernator_tpu.ops.engine import TickEngine
 
     mesh = make_mesh(jax.devices())
     m_eng = MeshTickEngine(mesh=mesh, local_capacity=64, max_batch=64)
     s_eng = TickEngine(capacity=512, max_batch=256)
     rng = np.random.default_rng(7)
-    for t in range(4):
+    for t in range(6):
         reqs = [
             RateLimitRequest(
                 name="cmp",
@@ -118,22 +121,29 @@ def test_matches_single_device_engine():
             )
             for _ in range(50)
         ]
-        # Same-key same-tick ordering is engine-defined; keep keys unique
-        # per tick for the equivalence check.
-        seen, uniq = set(), []
-        for r in reqs:
-            k = r.hash_key()
-            if k not in seen:
-                seen.add(k)
-                uniq.append(r)
-        a = m_eng.process(uniq, now=NOW + t * 1000)
-        b = s_eng.process(uniq, now=NOW + t * 1000)
+        if t < 3:
+            # Unique-key windows exercise the parts-native program...
+            seen, uniq = set(), []
+            for r in reqs:
+                k = r.hash_key()
+                if k not in seen:
+                    seen.add(k)
+                    uniq.append(r)
+            reqs = uniq
+        # ...and the rest keep their duplicates (the merge-capable
+        # program, arrival-order sequencing across both engines).
+        a = m_eng.process(reqs, now=NOW + t * 1000)
+        b = s_eng.process(reqs, now=NOW + t * 1000)
         for x, y in zip(a, b):
-            assert (x.status, x.remaining, x.reset_time) == (
+            assert (x.status, x.remaining, x.reset_time, x.error) == (
                 y.status,
                 y.remaining,
                 y.reset_time,
+                y.error,
             )
+    # The routed flat format served every window (no silent fallback).
+    assert m_eng.metric_routed_windows == 6
+    assert m_eng.metric_routed_overflows == 0
 
 
 @pytest.mark.skipif(
@@ -178,6 +188,94 @@ def test_mesh_row_layout_snapshot_roundtrip():
     e2.load_items(items, now=NOW + 1)
     out = e2.process([req("snapr3", hits=0, limit=9)], now=NOW + 1)[0]
     assert out.remaining == 7
+
+
+def test_routing_parity_fuzz_vs_host_ring(engine):
+    """Device-derived ownership must agree with the host hash ring for
+    every served key: the vectorized CRC-32 route, the scalar
+    ``_shard_of`` ring, slotmap residency (exactly one shard), and the
+    global-slot derivation (``slot // local_capacity``) — the invariant
+    the bench mesh rungs export as ``mesh_routing_parity_errors``."""
+    rng = np.random.default_rng(11)
+    keys = [
+        f"parity-{int(rng.integers(0, 1 << 30))}-{'x' * int(rng.integers(0, 40))}"
+        for _ in range(120)
+    ]
+    reqs = [req(k, limit=1000) for k in keys]
+    for s in range(0, len(reqs), 60):
+        engine.process(reqs[s:s + 60], now=NOW)
+    assert engine.routing_parity_errors(
+        [r.hash_key() for r in reqs]) == 0
+
+
+def test_route_function_parity_shard_counts():
+    """The vectorized CRC-32 router must be bit-identical to the scalar
+    zlib route at every shard count — including 1, odd, prime, and >8
+    (no engine builds: this is pure host routing math)."""
+    import zlib
+
+    from gubernator_tpu.native import crc32_batch
+
+    rng = np.random.default_rng(13)
+    keys = [b"", b"a", b"name_key", bytes(rng.integers(1, 255, 60).astype(np.uint8))] + [
+        f"k{int(rng.integers(0, 1 << 40))}".encode() for _ in range(200)
+    ]
+    blob = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    crcs = crc32_batch(blob, offsets)
+    for n_shards in (1, 2, 3, 5, 7, 8, 13):
+        vec = (crcs % np.uint32(n_shards)).astype(np.int64)
+        ref = [zlib.crc32(k) % n_shards for k in keys]
+        assert vec.tolist() == ref, n_shards
+
+
+def test_routed_trace_stability(engine):
+    """Re-dispatch must reuse the warmed routed programs: a signature
+    drift between warmup and serving (e.g. a committed device_put where
+    warmup used jnp.asarray) re-traces every program per tick (~0.6 s
+    each).  The ShardedOps trace counters only increment at trace time,
+    so they must not move across varied serving windows."""
+    # Unique window, then a duplicate-bearing window: both programs run.
+    engine.process([req(f"tr-{i}") for i in range(20)], now=NOW)
+    engine.process(
+        [req("tr-dup", hits=1) for _ in range(8)]
+        + [req(f"tr-{i}") for i in range(8)],
+        now=NOW + 1,
+    )
+    before = dict(engine.ops.trace_counts)
+    for t in range(3):
+        engine.process([req(f"tr2-{t}-{i}") for i in range(25)],
+                       now=NOW + 2 + t)
+        engine.process(
+            [req(f"tr2-dup-{t}", hits=1) for _ in range(6)]
+            + [req(f"tr2-{t}-{i}") for i in range(6)],
+            now=NOW + 10 + t,
+        )
+    assert dict(engine.ops.trace_counts) == before
+
+
+def test_routed_overflow_falls_back_to_blocked():
+    """A window whose per-shard row count exceeds the routed block
+    width (adversarial hash skew) must fall back to host-blocked
+    packing for that tick — correct answers, overflow counted."""
+    mesh = make_mesh(jax.devices()[:2])
+    eng = MeshTickEngine(
+        mesh=mesh, local_capacity=64, max_batch=32, local_width=4
+    )
+    # 20 keys that all route to shard 0: guaranteed to exceed 4 lanes.
+    shard0 = [
+        k for k in (f"ov{i}" for i in range(400))
+        if eng._shard_of(f"mesh_{k}") == 0
+    ][:20]
+    assert len(shard0) == 20
+    out = eng.process([req(k, limit=50) for k in shard0], now=NOW)
+    assert all(r.error == "" and r.remaining == 49 for r in out)
+    assert eng.metric_routed_overflows >= 1
+    # A balanced window afterwards routes on-device again.
+    out = eng.process([req(f"bal{i}", limit=50) for i in range(8)], now=NOW)
+    assert all(r.remaining == 49 for r in out)
+    assert eng.metric_routed_windows >= 1
 
 
 def test_mesh_store_write_and_read_through():

@@ -495,8 +495,13 @@ def test_repo_hot_path_markers_present():
         "gubernator_tpu/ops/engine.py": [
             "_build_cols", "_lease_matrix", "_promote_misses",
             "submit_columns", "submit_cols", "submit"],
+        # The sharded serving path: resolve + both dispatch formats
+        # (device-routed flat and host-blocked fallback) all run per
+        # serving window.
         "gubernator_tpu/parallel/mesh_engine.py": [
-            "submit_columns", "submit_cols", "submit"],
+            "submit_columns", "submit_cols", "submit",
+            "_gregorian_cols", "_resolve_columns", "_account_misses",
+            "_dispatch_routed", "_dispatch_blocked"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
         # Zero-copy ingest edge: the wire decode/encode and the arena
         # lease run once per serving window too.
