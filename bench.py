@@ -1285,6 +1285,7 @@ async def _loopback_bench(engine, n_keys):
     from gubernator_tpu.pb import gubernator_pb2 as pb
     from gubernator_tpu.service.instance import InstanceConfig, V1Instance
     from gubernator_tpu.transport import convert, fastwire
+    from gubernator_tpu.utils import flightrec
 
     batch = 1000  # the public API batch cap (types.MAX_BATCH_SIZE)
     now = 1_700_000_000_000
@@ -1326,14 +1327,25 @@ async def _loopback_bench(engine, n_keys):
             ]
 
         async def serve(raw):
-            """One server round trip: the V1Servicer fast path inline."""
+            """One server round trip: the V1Servicer fast path inline.
+            Records the transport edges (decode/encode) when a flight
+            recorder is installed — the daemon's servicer does the same,
+            so the telemetry-on phase below measures the real
+            instrumented path."""
+            fr = flightrec.get()
+            t0 = time.perf_counter() if fr is not None else 0.0
             parsed = fastwire.parse_req(raw, arena)
+            if fr is not None:
+                fr.edge("decode", time.perf_counter() - t0)
             if parsed is None:
                 msg = pb.GetRateLimitsReq.FromString(raw)
                 parsed = convert.columns_from_pb(msg.requests)
             cols, errors, special = parsed
             mat, errs = await inst.get_rate_limits_columns(cols)
+            t1 = time.perf_counter() if fr is not None else 0.0
             out = fastwire.encode_resp(mat)
+            if fr is not None:
+                fr.edge("encode", time.perf_counter() - t1)
             # Client-side decode closes the loop (the response bytes
             # must be real and parseable, or the rung measures a write
             # into the void).
@@ -1389,6 +1401,31 @@ async def _loopback_bench(engine, n_keys):
         windows = getattr(engine, "metric_h2d_windows", 0) - h2d_w0
         overlapped = getattr(engine, "metric_h2d_overlapped", 0) - h2d_o0
 
+        # Telemetry-on phase (docs/observability.md): the same drive
+        # pattern with a flight recorder installed, so the record
+        # carries (a) per-stage p50/p99 from real serving windows and
+        # (b) the measured cost of the instrumentation itself.  The
+        # overhead ratio compares best segment against best segment —
+        # medians would fold scheduler noise into a number whose gate
+        # (≤1.05×, check_bench_regression.py) is tight.
+        prev_rec = flightrec.get()
+        rec = flightrec.FlightRecorder(windows=512)
+        flightrec.install(rec)
+        try:
+            await asyncio.gather(*(one(i) for i in range(concurrency)))
+            on_rates = []
+            for _ in range(3):
+                s0 = time.perf_counter()
+                await asyncio.gather(*(one(i) for i in range(n_tp)))
+                on_rates.append(
+                    n_tp * batch / max(time.perf_counter() - s0, 1e-9))
+            stage_pcts = rec.stage_percentiles()
+        finally:
+            if prev_rec is not None:
+                flightrec.install(prev_rec)
+            else:
+                flightrec.uninstall()
+
         # Host serving CPU per batch, codec + arena decode inline (the
         # same metric the service rung records; the device never runs).
         cpu_best = 1e9
@@ -1422,7 +1459,13 @@ async def _loopback_bench(engine, n_keys):
                 overlapped / max(1, windows), 4),
             "arena_leases": getattr(arena, "metric_leases", 0),
             "arena_misses": getattr(arena, "metric_misses", 0),
+            "telemetry_overhead_ratio": round(
+                max(seg) / max(max(on_rates), 1e-9), 4),
         }
+        for s in ("decode", "pack", "h2d", "tick", "encode"):
+            pct = stage_pcts.get(s, {})
+            out[f"stage_{s}_p50_ms"] = pct.get("p50_ms", 0.0)
+            out[f"stage_{s}_p99_ms"] = pct.get("p99_ms", 0.0)
         if native:
             out["serve_cpu_ms_per_batch"] = round(cpu_best * 1e3, 3)
         return out

@@ -58,6 +58,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_COLD_CACHE_SIZE": "host-side cold-tier entry budget (0 = off)",
     "GUBER_COMPILE_CACHE_DIR": "persistent XLA compile cache dir / 'off'",
     "GUBER_DATA_CENTER": "datacenter name for region-aware picking",
+    "GUBER_DEBUG_ENDPOINTS": "serve /debug/* introspection endpoints (0/1)",
     "GUBER_DISABLE_BATCHING": "disable peer-forwarding batches",
     "GUBER_DNS_FQDN": "dns discovery: name to resolve for peers",
     "GUBER_DRAIN_TIMEOUT": "graceful-shutdown GLOBAL flush budget",
@@ -72,6 +73,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_FAULT_PARTITION": "fault injection: 100% UNAVAILABLE",
     "GUBER_FAULT_PEERS": "fault injection: target peers or '*'",
     "GUBER_FAULT_SEED": "fault injection: RNG seed",
+    "GUBER_FLIGHT_RECORDER_WINDOWS": "flight-recorder ring size (window records)",
     "GUBER_FORCE_GLOBAL": "force GLOBAL behavior on every request",
     "GUBER_FORWARD_BACKOFF_BASE": "forward-retry backoff base",
     "GUBER_FORWARD_BACKOFF_CAP": "forward-retry backoff cap",
@@ -103,6 +105,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_REDELIVERY_LIMIT": "GLOBAL redelivery buffer cap",
     "GUBER_REPLICATED_HASH_REPLICAS": "consistent-hash virtual replicas",
     "GUBER_RESOLV_CONF": "dns discovery: resolv.conf path",
+    "GUBER_SLOW_WINDOW_MS": "slow-window watchdog threshold in ms (0 = off)",
     "GUBER_SNAPSHOT_DELTAS_PER_BASE": "delta records per base compaction",
     "GUBER_SNAPSHOT_DIR": "crash-safe snapshot directory ('' = off)",
     "GUBER_SNAPSHOT_INTERVAL": "delta snapshot cadence (seconds)",

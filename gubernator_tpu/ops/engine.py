@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
@@ -57,7 +58,7 @@ from gubernator_tpu.types import (
     Status,
     has_behavior,
 )
-from gubernator_tpu.utils import timeutil, tracing
+from gubernator_tpu.utils import flightrec, timeutil, tracing
 from gubernator_tpu.utils.hotpath import hot_path
 
 
@@ -401,7 +402,8 @@ class StagingRing:
     one.  Callers hold their engine lock around lease()/retire() (ring
     state is unsynchronized)."""
 
-    __slots__ = ("rows", "sentinel", "depth", "_stage", "_next", "_leased")
+    __slots__ = ("rows", "sentinel", "depth", "_stage", "_next", "_leased",
+                 "metric_leases", "metric_fallback_allocs")
 
     def __init__(self, rows: int, sentinel: int, depth: int):
         self.rows = int(rows)
@@ -410,6 +412,11 @@ class StagingRing:
         self._stage: Dict[int, list] = {}   # width -> [[matrix, handle]]
         self._next: Dict[int, int] = {}
         self._leased: Optional[list] = None
+        # Plain-int telemetry (caller holds the engine lock): total
+        # leases and how many missed the ring entirely (every slab
+        # in flight → fresh allocation) — surfaced by /debug/state.
+        self.metric_leases = 0
+        self.metric_fallback_allocs = 0
 
     def lease(self, b: int) -> np.ndarray:
         """A zeroed (rows, b) slab with the slot row pre-set to the
@@ -430,11 +437,13 @@ class StagingRing:
                 slot = cand
                 self._next[b] = (start + k + 1) % len(ring)
                 break
+        self.metric_leases += 1
         if slot is None:
             # Every slab still feeds an unresolved window (caller is
             # pipelining deeper than the ring): plain allocation.
             m = np.empty((self.rows, b), np.int32)
             self._leased = None
+            self.metric_fallback_allocs += 1
         else:
             slot[1] = None
             m = slot[0]
@@ -442,6 +451,22 @@ class StagingRing:
         m.fill(0)
         m[REQ32_INDEX["slot"]] = self.sentinel
         return m
+
+    def telemetry(self) -> dict:
+        """Snapshot for /debug/state: ring shape, per-width slab counts
+        and how many slabs are currently bound to unresolved handles."""
+        widths = {}
+        for w, ring in self._stage.items():
+            in_flight = sum(
+                1 for _, h in ring if h is not None and h._done is None
+            )
+            widths[int(w)] = {"slabs": len(ring), "in_flight": in_flight}
+        return {
+            "depth": self.depth,
+            "leases": self.metric_leases,
+            "fallback_allocs": self.metric_fallback_allocs,
+            "widths": widths,
+        }
 
     def retire(self, handle) -> None:
         """Bind the most recent lease to the tick handle consuming it
@@ -2563,7 +2588,13 @@ class TickEngine:
         (slot row pre-set to the padding sentinel) — see
         :class:`StagingRing` for the recycle contract.  Called under the
         engine lock (ring state is unsynchronized)."""
-        return self._staging.lease(b)
+        fr = flightrec.get()
+        if fr is None:
+            return self._staging.lease(b)
+        t0 = time.perf_counter()
+        m = self._staging.lease(b)
+        fr.note(fr.active(), "lease", time.perf_counter() - t0)
+        return m
 
     @hot_path
     def _build_cols(self, cols: ReqColumns, now: int):
@@ -2816,7 +2847,15 @@ class TickEngine:
             now = now if now is not None else timeutil.now_ms()
             self._last_now = max(self._last_now, now)
             self._tick_count += 1
+            # Flight-recorder stage notes (docs/observability.md): "pack"
+            # covers slot resolve + matrix fill + argsort (the lease is
+            # also broken out inside _lease_matrix); "h2d" the queued
+            # device dispatch below.
+            fr = flightrec.get()
+            t_pack = time.perf_counter() if fr is not None else 0.0
             packed, n, errors, inv, has_dups = self._build_cols(cols, now)
+            if fr is not None:
+                fr.note(fr.active(), "pack", time.perf_counter() - t_pack)
             dev_m = None
             # Named range in XProf captures (utils/tracing.py): device
             # tick vs host packing shows up separated in the profile.
@@ -2824,6 +2863,7 @@ class TickEngine:
                 build_group_plan(packed, n, self.capacity, now)
                 if has_dups else None
             )
+            t_h2d = time.perf_counter() if fr is not None else 0.0
             with tracing.profile_annotation("guber.tick"):
                 if plan is not None:
                     # Grouped tick: unique heads through the parts
@@ -2895,6 +2935,8 @@ class TickEngine:
                     self.state, resp = self._tick32(
                         self.state, dev_m, jnp.int64(now)
                     )
+            if fr is not None:
+                fr.note(fr.active(), "h2d", time.perf_counter() - t_h2d)
             self._pending.clear()
             tick_slots = packed[REQ32_INDEX["slot"], :n]
             # Dirty marking feeds export_columns(dirty_only=True); pure

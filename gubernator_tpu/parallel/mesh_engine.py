@@ -51,6 +51,7 @@ the canonical spec helper both mesh engines share.
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence
 
@@ -101,7 +102,7 @@ from gubernator_tpu.ops.reqcols import CREATED_UNSET
 from gubernator_tpu.ops.rowtable import ROW_W, RowState
 from gubernator_tpu.types import (
     Behavior, GlobalUpdate, RateLimitRequest, RateLimitResponse)
-from gubernator_tpu.utils import timeutil
+from gubernator_tpu.utils import flightrec, timeutil, tracing
 from gubernator_tpu.utils.hotpath import hot_path
 
 
@@ -722,9 +723,18 @@ class MeshTickEngine:
         after reclaim become per-item errors (the reference's
         error-in-item convention).  Returns ``(sh, slots, known)`` with
         resolved rows stamped live (``_last_access``/``_pending``)."""
+        n = len(cols)
+        # Named range + span like the single-chip tick path: host-side
+        # shard routing shows up separated from device work in XProf
+        # captures, and traced windows carry the resolve as a child span.
+        with tracing.profile_annotation("guber.mesh.resolve"), \
+                tracing.maybe_span("guber.mesh.resolve", {"batch": n}):
+            return self._resolve_columns_locked(cols, now, errors, n)
+
+    @hot_path
+    def _resolve_columns_locked(self, cols, now, errors, n):
         from gubernator_tpu.native import crc32_batch
 
-        n = len(cols)
         # Key → shard (vectorized CRC-32 over the packed key blob).
         sh = (
             crc32_batch(cols.key_blob, cols.key_offsets)
@@ -869,22 +879,37 @@ class MeshTickEngine:
         no per-shard host loop, responses gathered with one psum."""
         n = len(cols)
         b = self.max_batch
+        # Flight-recorder stage notes + named ranges/spans, mirroring the
+        # single-chip TickEngine.submit_columns instrumentation.
+        fr = flightrec.get()
+        t0 = time.perf_counter() if fr is not None else 0.0
         m = self._staging.lease(b)
+        if fr is not None:
+            fr.note(fr.active(), "lease", time.perf_counter() - t0)
+            t0 = time.perf_counter()
         ix = np.flatnonzero(ok)
         gslot = sh[ix] * self.local_capacity + slots[ix]
         pack_cols_req32(m, cols, gslot, known[ix], now, ix)
         pack_wide_rows(m, "greg_exp", greg_e[ix], ix)
         pack_wide_rows(m, "greg_dur", greg_d[ix], ix)
         inv, has_dups = sort_packed_by_slot(m, n, self.capacity)
-        dev_m = jnp.asarray(m)
-        if has_dups:
-            self.state, resp = self.ops.tick_routed(
-                self.state, dev_m, jnp.int64(now)
-            )
-        else:
-            self.state, resp = self.ops.run_tick_routed_unique(
-                self.state, dev_m, jnp.int64(now)
-            )
+        if fr is not None:
+            fr.note(fr.active(), "pack", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+        with tracing.profile_annotation("guber.mesh.tick"), \
+                tracing.maybe_span("guber.mesh.dispatch_routed",
+                                   {"batch": n}):
+            dev_m = jnp.asarray(m)
+            if has_dups:
+                self.state, resp = self.ops.tick_routed(
+                    self.state, dev_m, jnp.int64(now)
+                )
+            else:
+                self.state, resp = self.ops.run_tick_routed_unique(
+                    self.state, dev_m, jnp.int64(now)
+                )
+        if fr is not None:
+            fr.note(fr.active(), "h2d", time.perf_counter() - t0)
         self._pending.clear()
         self.metric_routed_windows += 1
         wt_args = None

@@ -28,6 +28,7 @@ from typing import List, Sequence
 import numpy as np
 
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+from gubernator_tpu.utils import flightrec
 from gubernator_tpu.utils.hotpath import hot_path
 
 _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
@@ -186,6 +187,13 @@ class TickLoop:
         """Dispatch one window.  Object and columnar submissions each
         coalesce into (at most) one engine submission; both ride the same
         resolver handoff and resolve together in one D2H."""
+        # Flight-recorder window open (docs/observability.md): the engine
+        # notes lease/pack/h2d into the active window while we dispatch.
+        fr = flightrec.get()
+        wid = None
+        if fr is not None:
+            wid = fr.begin(
+                sum(n for _, _, n, _ in batch), self._resolve_q.qsize())
         t0 = time.perf_counter()
         obj_items: List[tuple] = []   # (n, fut)
         reqs: List[RateLimitRequest] = []
@@ -231,11 +239,16 @@ class TickLoop:
                 for p in col_parts:
                     p.release()
         if not subs:
+            if fr is not None and wid is not None:
+                fr.end_dispatch(wid)
+                fr.finish(wid)
             return
+        if fr is not None and wid is not None:
+            fr.end_dispatch(wid)
         # Bounded handoff: blocks when pipeline_depth windows are already
         # in flight (device behind), which is exactly the backpressure the
         # dispatch thread should feel.
-        self._resolve_q.put((subs, time.perf_counter() - t0))
+        self._resolve_q.put((subs, time.perf_counter() - t0, wid))
 
     def _resolve_loop(self) -> None:
         while True:
@@ -258,18 +271,27 @@ class TickLoop:
                     stop = True
                     break
                 items.append(nxt)
+            fr = flightrec.get()
+            t_drain = time.perf_counter()
             try:
                 from gubernator_tpu.ops.engine import resolve_ticks
 
                 resolve_ticks([
                     h
-                    for subs, _ in items
+                    for subs, _, _ in items
                     for _, sb, _, _ in subs
                     for h in sb.handles()
                 ])
             except Exception:
                 pass  # per-window resolution below surfaces real errors
-            for subs, dispatch_s in items:
+            if fr is not None:
+                # All drained windows shared this one D2H wait; each
+                # reports it as its tick time (documented in flightrec).
+                drain_s = time.perf_counter() - t_drain
+                for _, _, wid in items:
+                    if wid is not None:
+                        fr.note(wid, "tick", drain_s)
+            for subs, dispatch_s, wid in items:
                 for kind, sb, waiters, n_reqs in subs:
                     # Guarded: an exception escaping this loop would kill
                     # the resolver thread and wedge the whole pipeline
@@ -280,6 +302,8 @@ class TickLoop:
                             sb.responses() if kind == "obj" else sb.matrix()
                         )
                         resolve_s = time.perf_counter() - t1
+                        if fr is not None and wid is not None:
+                            fr.note(wid, "resolve", resolve_s)
                     except Exception as e:
                         _fail_waiters(waiters, e)
                         continue
@@ -292,6 +316,8 @@ class TickLoop:
                         logging.getLogger("gubernator.tickloop").exception(
                             "tick delivery failed"
                         )
+                if fr is not None and wid is not None:
+                    fr.finish(wid)
             if stop:
                 return
 
@@ -402,7 +428,7 @@ class TickLoop:
             if item is None:
                 saw_sentinel = True
                 continue
-            subs, _ = item
+            subs = item[0]
             for _, _, items, _ in subs:
                 _fail_waiters(items, err)
         if saw_sentinel:

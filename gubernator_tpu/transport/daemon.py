@@ -21,9 +21,10 @@ import grpc.aio
 from aiohttp import web
 from google.protobuf import json_format
 
-from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.config import DaemonConfig, env_knob
 from gubernator_tpu.pb import gubernator_pb2 as pb
 from gubernator_tpu.pb import peers_pb2 as peers_pb
+from gubernator_tpu.resilience.supervisor import spawn_supervised
 from gubernator_tpu.service.instance import (
     BatchTooLargeError,
     InstanceConfig,
@@ -33,7 +34,7 @@ from gubernator_tpu.transport import convert, fastwire
 from gubernator_tpu.transport.grpc_api import V1Stub, peers_handler, v1_handler
 from gubernator_tpu.transport.tlsutil import TLSBundle, setup_tls
 from gubernator_tpu.types import GlobalUpdate, PeerInfo
-from gubernator_tpu.utils import tracing
+from gubernator_tpu.utils import flightrec, tracing
 from gubernator_tpu.utils.metrics import Metrics
 
 log = logging.getLogger("gubernator.daemon")
@@ -64,9 +65,13 @@ class _StatsInterceptor(grpc.aio.ServerInterceptor):
                 failed = True
                 raise
             finally:
-                metrics.grpc_request_duration.labels(method=method).observe(
-                    time.perf_counter() - t0
-                )
+                dt = time.perf_counter() - t0
+                metrics.grpc_request_duration.labels(method=method).observe(dt)
+                # Histogram family with log-spaced buckets: the Summary
+                # above keeps reference-catalog parity; the histogram is
+                # what per-method p99 dashboards and exemplar linkage
+                # read (docs/observability.md).
+                metrics.grpc_duration_hist.labels(method=method).observe(dt)
                 metrics.grpc_request_counts.labels(
                     status="failed" if failed else "success", method=method
                 ).inc()
@@ -156,7 +161,13 @@ async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
     path release it here."""
     msg = None
     if gate_ok:
+        # Flight-recorder transport edges: per-batch decode/encode CPU
+        # (folded into window records — see utils/flightrec.py).
+        fr = flightrec.get()
+        t0 = time.perf_counter() if fr is not None else 0.0
         parsed = fastwire.parse_req(raw, arena)
+        if fr is not None:
+            fr.edge("decode", time.perf_counter() - t0)
         if parsed is None:  # codec unavailable or malformed bytes
             msg = await _parse_pb(msg_type, raw, context)
             parsed = convert.columns_from_pb(msg.requests)
@@ -170,7 +181,11 @@ async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
             if not errs:
                 # Native wire encoding straight from the matrix; the
                 # method's pass-through serializer ships bytes as-is.
-                return fastwire.encode_resp(mat), msg
+                t1 = time.perf_counter() if fr is not None else 0.0
+                out = fastwire.encode_resp(mat)
+                if fr is not None:
+                    fr.edge("encode", time.perf_counter() - t1)
+                return out, msg
             return _item_responses(mat, errs), msg
         cols.release()  # object path re-parses; the slab is dead weight
     return None, msg
@@ -297,6 +312,19 @@ class Daemon:
         # quorum) stays truthful about the process itself.
         self._ready = False
         self._draining = False
+        # /debug introspection surface (docs/observability.md): enabling
+        # it also installs the flight recorder and an in-memory trace
+        # exporter so /debug/pipeline and /debug/traces have data.  The
+        # slow-window watchdog installs the recorder even without the
+        # endpoints (its dumps go to the log + slow_windows counter).
+        self._debug_enabled = bool(
+            env_knob("GUBER_DEBUG_ENDPOINTS", 0, parse=int))
+        self._slow_window_ms = env_knob(
+            "GUBER_SLOW_WINDOW_MS", 0.0, parse=float)
+        self._flight_recorder: Optional[flightrec.FlightRecorder] = None
+        self._debug_exporter: Optional[tracing.InMemoryExporter] = None
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self._profiling = False
 
     # ------------------------------------------------------------------
     @property
@@ -331,6 +359,27 @@ class Daemon:
         # Rewrite :0 binds to the allocated port so peers/tests can dial it.
         host = self.conf.grpc_listen_address.rsplit(":", 1)[0]
         self.conf.grpc_listen_address = f"{host}:{port}"
+
+        if self._debug_enabled or self._slow_window_ms > 0:
+            windows = env_knob(
+                "GUBER_FLIGHT_RECORDER_WINDOWS", 256, parse=int)
+            rec = flightrec.FlightRecorder(
+                windows=max(2, windows),
+                slow_threshold_s=self._slow_window_ms / 1e3,
+            )
+            rec.observer = self._observe_stage
+            flightrec.install(rec)
+            self._flight_recorder = rec
+            self._watchdog_task = spawn_supervised(
+                self._watchdog_loop,
+                name="flight_watchdog",
+                should_restart=lambda: not self._draining,
+                metrics=self.metrics,
+                loop_label="flight_watchdog",
+            )
+        if self._debug_enabled:
+            self._debug_exporter = tracing.InMemoryExporter()
+            tracing.add_exporter(self._debug_exporter)
 
         # Gateway comes up BEFORE the instance: a snapshot restore can
         # take seconds, and readiness probes must get a real 503 from
@@ -380,7 +429,15 @@ class Daemon:
         app.router.add_get("/readyz", self._h_readyz)
         if include_metrics:
             app.router.add_get("/metrics", self._h_metrics)
+        if self._debug_enabled:
+            self._add_debug_routes(app)
         return app
+
+    def _add_debug_routes(self, app: web.Application) -> None:
+        app.router.add_get("/debug/pipeline", self._h_debug_pipeline)
+        app.router.add_get("/debug/traces", self._h_debug_traces)
+        app.router.add_get("/debug/state", self._h_debug_state)
+        app.router.add_get("/debug/profile", self._h_debug_profile)
 
     async def _start_gateway(self) -> None:
         if not self.conf.http_listen_address:
@@ -407,6 +464,8 @@ class Daemon:
             sapp.router.add_get("/healthz", self._h_health_check)
             sapp.router.add_get("/readyz", self._h_readyz)
             sapp.router.add_get("/metrics", self._h_metrics)
+            if self._debug_enabled:
+                self._add_debug_routes(sapp)
             srunner = web.AppRunner(sapp, access_log=None)
             await srunner.setup()
             shost, _, sport = self.conf.http_status_listen_address.rpartition(":")
@@ -500,6 +559,178 @@ class Daemon:
         )
 
     # ------------------------------------------------------------------
+    # /debug introspection surface (docs/observability.md)
+    # ------------------------------------------------------------------
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        """Flight-recorder observer: per-stage latency histogram."""
+        self.metrics.stage_duration.labels(stage=stage).observe(seconds)
+
+    async def _watchdog_loop(self) -> None:
+        """Drain slow-window records parked by FlightRecorder.finish().
+
+        finish() runs on the dispatch hot path so it only does the float
+        compare and a bounded-deque append; everything observable — the
+        slow_windows counter, the log dump — happens here off the hot
+        path, under the supervisor like every other background loop."""
+        while not self._draining:
+            rec = self._flight_recorder
+            if rec is not None:
+                for dump in rec.drain_slow():
+                    self.metrics.slow_windows.inc()
+                    log.warning(
+                        "slow window %d: total=%.1fms width=%d depth=%d "
+                        "stages_ms=%s",
+                        dump["window"], dump["total_ms"], dump["width"],
+                        dump["queue_depth"],
+                        {s: v for s, v in dump["stages_ms"].items() if v},
+                    )
+            await asyncio.sleep(0.25)
+
+    async def _h_debug_pipeline(self, request: web.Request) -> web.Response:
+        rec = self._flight_recorder
+        if rec is None:
+            return web.json_response(
+                {"error": "flight recorder not installed"}, status=404
+            )
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        return web.json_response({
+            "windows": rec.recent(max(1, limit)),
+            "stage_percentiles": rec.stage_percentiles(),
+            "slow_windows": rec.slow_total,
+        })
+
+    @staticmethod
+    def _span_dict(span: tracing.Span) -> dict:
+        attrs = {
+            k: v if isinstance(v, (str, int, float, bool, type(None)))
+            else repr(v)
+            for k, v in span.attributes.items()
+        }
+        return {
+            "name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_span_id": span.parent_span_id,
+            "start_ns": span.start_ns,
+            "duration_ms": round(span.duration_ms, 4),
+            "attributes": attrs,
+            "error": span.error,
+        }
+
+    async def _h_debug_traces(self, request: web.Request) -> web.Response:
+        exp = self._debug_exporter
+        if exp is None:
+            return web.json_response(
+                {"error": "trace exporter not installed"}, status=404
+            )
+        trace_id = request.query.get("trace_id")
+        name = request.query.get("name")
+        try:
+            limit = int(request.query.get("limit", "128"))
+        except ValueError:
+            return web.json_response({"error": "bad limit"}, status=400)
+        if trace_id:
+            spans = exp.by_trace(trace_id)
+        elif name:
+            spans = exp.by_name(name)
+        else:
+            with exp._lock:
+                spans = list(exp.spans)
+        spans = spans[-max(1, limit):]
+        return web.json_response({
+            "tracing_enabled": tracing.enabled(),
+            "count": len(spans),
+            "spans": [self._span_dict(s) for s in spans],
+        })
+
+    async def _h_debug_state(self, request: web.Request) -> web.Response:
+        if self.instance is None:
+            return web.json_response({"error": "starting up"}, status=503)
+        inst = self.instance
+        eng = inst.engine
+        body: dict = {
+            "ready": self._ready,
+            "draining": self._draining,
+            "occupancy": inst.occupancy(),
+            "restore": inst.restore_stats,
+        }
+        arena = inst.ingest_arena
+        if arena is not None:
+            body["ingest_arena"] = {
+                "slabs": arena.n_slabs,
+                "in_use": arena.in_use(),
+                "leases": arena.metric_leases,
+                "misses": arena.metric_misses,
+            }
+        engine_tel: dict = {}
+        if hasattr(eng, "h2d_overlap_ratio"):
+            engine_tel["h2d_windows"] = eng.metric_h2d_windows
+            engine_tel["h2d_overlap_ratio"] = round(
+                eng.h2d_overlap_ratio(), 4)
+        staging = getattr(eng, "_staging", None)
+        if staging is not None and hasattr(staging, "telemetry"):
+            engine_tel["staging_ring"] = staging.telemetry()
+        if engine_tel:
+            body["engine"] = engine_tel
+        body["breakers"] = {
+            p.info.grpc_address: p.breaker.state.name
+            for p in inst.local_picker.peers()
+        }
+        gm = inst.global_mgr
+        body["redelivery"] = {
+            "hits": len(gm._hits),
+            "updates": len(gm._updates),
+            "owned": len(gm._owned),
+        }
+        writer = getattr(inst, "_snapshot_writer", None)
+        if writer is not None:
+            body["snapshot"] = {
+                "generation": writer.store.generation,
+                "delta_writes": writer.metric_delta_writes,
+                "base_writes": writer.metric_base_writes,
+                "write_failures": writer.metric_write_failures,
+            }
+        return web.json_response(body)
+
+    async def _h_debug_profile(self, request: web.Request) -> web.Response:
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response({"error": "bad seconds"}, status=400)
+        if not 0 < seconds <= 30:
+            return web.json_response(
+                {"error": "seconds must be in (0, 30]"}, status=400
+            )
+        if self._profiling:
+            return web.json_response(
+                {"error": "capture already running"}, status=409
+            )
+        self._profiling = True
+        try:
+            import tempfile
+
+            import jax
+
+            out_dir = tempfile.mkdtemp(prefix="guber-profile-")
+            jax.profiler.start_trace(out_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            return web.json_response(
+                {"trace_dir": out_dir, "seconds": seconds}
+            )
+        except Exception as exc:  # profiler may be busy / unavailable
+            return web.json_response(
+                {"error": f"{type(exc).__name__}: {exc}"}, status=500
+            )
+        finally:
+            self._profiling = False
+
+    # ------------------------------------------------------------------
     # Discovery (daemon.go:208-243)
     # ------------------------------------------------------------------
     async def _start_discovery(self) -> None:
@@ -585,6 +816,22 @@ class Daemon:
         GLOBAL buffers flushed under the bounded deadline and the final
         base snapshot written inside instance.close — then listeners."""
         self._draining = True
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._watchdog_task = None
+        if self._debug_exporter is not None:
+            tracing.remove_exporter(self._debug_exporter)
+            self._debug_exporter = None
+        if (self._flight_recorder is not None
+                and flightrec.get() is self._flight_recorder):
+            # Only drop the module-global slot if it is still ours — an
+            # in-process test cluster shares it across daemons.
+            flightrec.uninstall()
+        self._flight_recorder = None
         if self._pool is not None:
             await self._pool.close()
         if self.instance is not None:

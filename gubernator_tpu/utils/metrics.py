@@ -11,6 +11,11 @@ runs many daemons per process, like ``cluster/cluster.go``).
 
 from __future__ import annotations
 
+import bisect
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -18,8 +23,155 @@ from prometheus_client import (
     Summary,
     generate_latest,
 )
+from prometheus_client.core import HistogramMetricFamily
 
 CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket bounds from ``lo`` up to at least ``hi``."""
+    step = 10.0 ** (1.0 / per_decade)
+    out = [lo]
+    while out[-1] < hi:
+        out.append(out[-1] * step)
+    return tuple(round(b, 12) for b in out)
+
+
+# 100 µs … ~56 s at 4 buckets/decade — covers fastwire decode (~10 µs at
+# the floor bucket) through a pathological multi-second window.
+DEFAULT_BUCKETS = log_buckets(100e-6, 56.0)
+
+
+class _HistogramChild:
+    """One label-combination series.  The observe path takes no lock:
+    a single ``list[i] += 1`` is serialized by the GIL, and the worst
+    race outcome is one scrape reading a bucket/sum pair mid-update —
+    acceptable skew for telemetry, and what keeps the hot serving path
+    lock-free."""
+
+    __slots__ = ("_bounds", "_counts", "_sum", "_exemplars")
+
+    def __init__(self, bounds: Sequence[float]):
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        # Per-bucket last exemplar: (trace_id, value, unix_ts) or None.
+        self._exemplars: list = [None] * (len(bounds) + 1)
+
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        i = bisect.bisect_left(self._bounds, value)
+        self._counts[i] += 1
+        self._sum += value
+        if trace_id is None:
+            trace_id = _current_trace_id()
+        if trace_id is not None:
+            self._exemplars[i] = (trace_id, value, time.time())
+
+
+def _current_trace_id() -> Optional[str]:
+    """Trace id of the active span, or None when tracing is off.  Late
+    import keeps utils.metrics importable without utils.tracing."""
+    from gubernator_tpu.utils import tracing
+
+    if not tracing.enabled():
+        return None
+    span = tracing.current_span()
+    return None if span is None else span.context.trace_id
+
+
+class Histogram:
+    """Lock-light fixed-bucket histogram with optional OpenMetrics
+    exemplars.
+
+    Buckets are log-spaced and fixed at construction (DEFAULT_BUCKETS:
+    100 µs – 56 s, 4/decade).  Registered as a custom collector so
+    ``Metrics.expose()`` / ``Metrics.sample()`` see the standard
+    ``_bucket``/``_sum``/``_count`` series; ``openmetrics()`` renders the
+    OpenMetrics exposition including ``# {trace_id="…"}`` exemplars so a
+    bad p99 bucket links back to the trace that landed in it."""
+
+    def __init__(
+        self,
+        name: str,
+        documentation: str,
+        labelnames: Sequence[str] = (),
+        registry: Optional[CollectorRegistry] = None,
+        buckets: Optional[Sequence[float]] = None,
+    ):
+        self._name = name
+        self._doc = documentation
+        self._labelnames = tuple(labelnames)
+        self._bounds = tuple(buckets if buckets is not None else DEFAULT_BUCKETS)
+        if list(self._bounds) != sorted(self._bounds):
+            raise ValueError("histogram buckets must be sorted")
+        self._lock = threading.Lock()  # guards child creation only
+        self._children: Dict[Tuple[str, ...], _HistogramChild] = {}
+        if not self._labelnames:
+            self._children[()] = _HistogramChild(self._bounds)
+        if registry is not None:
+            registry.register(self)
+
+    # -- write path ----------------------------------------------------
+    def labels(self, **labelvalues: str) -> _HistogramChild:
+        key = tuple(str(labelvalues[n]) for n in self._labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, _HistogramChild(self._bounds))
+        return child
+
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        if self._labelnames:
+            raise ValueError(f"{self._name} needs labels(); has labelnames")
+        self._children[()].observe(value, trace_id)
+
+    # -- read path -----------------------------------------------------
+    def collect(self):
+        fam = HistogramMetricFamily(
+            self._name, self._doc, labels=list(self._labelnames))
+        for key, child in list(self._children.items()):
+            cum = 0
+            rows = []
+            counts = list(child._counts)
+            for bound, n in zip(self._bounds, counts):
+                cum += n
+                rows.append((_fmt_le(bound), cum))
+            rows.append(("+Inf", cum + counts[-1]))
+            fam.add_metric(list(key), rows, sum_value=child._sum)
+        yield fam
+
+    def openmetrics(self) -> str:
+        """OpenMetrics exposition for this family, with exemplars."""
+        lines = [f"# TYPE {self._name} histogram",
+                 f"# HELP {self._name} {self._doc}"]
+        for key, child in sorted(self._children.items()):
+            base = list(zip(self._labelnames, key))
+            cum = 0
+            counts = list(child._counts)
+            bounds = list(self._bounds) + [float("inf")]
+            for i, bound in enumerate(bounds):
+                cum += counts[i]
+                le = "+Inf" if bound == float("inf") else _fmt_le(bound)
+                labels = "".join(f'{k}="{v}",' for k, v in base)
+                line = f'{self._name}_bucket{{{labels}le="{le}"}} {cum}'
+                ex = child._exemplars[i]
+                if ex is not None:
+                    tid, val, ts = ex
+                    line += (f' # {{trace_id="{tid}"}} {_fmt_le(val)}'
+                             f" {ts:.3f}")
+                lines.append(line)
+            label_str = ",".join(f'{k}="{v}"' for k, v in base)
+            braces = f"{{{label_str}}}" if label_str else ""
+            lines.append(f"{self._name}_count{braces} {cum}")
+            lines.append(f"{self._name}_sum{braces} {_fmt_le(child._sum)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_le(v: float) -> str:
+    """Shortest float repr (Prometheus le label convention)."""
+    s = repr(float(v))
+    return s[:-2] if s.endswith(".0") else s
 
 
 class Metrics:
@@ -366,6 +518,34 @@ class Metrics:
             "restarted by their crash supervisor after an unexpected "
             "exception.",
             ["loop"],
+            registry=reg,
+        )
+
+        # Serving telemetry plane (docs/observability.md): per-method RPC
+        # latency and per-stage window latency as log-spaced histograms
+        # (exemplars link a bad bucket to its trace when tracing is on),
+        # plus the slow-window watchdog counter.
+        self.grpc_duration_hist = Histogram(
+            "gubernator_tpu_grpc_duration_seconds",
+            "Per-method gRPC request latency histogram (log-spaced "
+            "buckets; OpenMetrics exemplars carry the request span's "
+            "trace id).",
+            ["method"],
+            registry=reg,
+        )
+        self.stage_duration = Histogram(
+            "gubernator_tpu_stage_duration_seconds",
+            "Per-stage serving-window latency histogram (stages: decode, "
+            "lease, pack, h2d, tick, resolve, encode), fed by the flight "
+            "recorder when one is installed.",
+            ["stage"],
+            registry=reg,
+        )
+        self.slow_windows = Counter(
+            "gubernator_tpu_slow_windows",
+            "Serving windows whose summed stage time exceeded "
+            "GUBER_SLOW_WINDOW_MS; each one's flight record is dumped to "
+            "the log by the watchdog.",
             registry=reg,
         )
 

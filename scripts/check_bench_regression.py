@@ -75,9 +75,25 @@ COUNT_KEYS = (
 #                           batch p99 — lower is better, 1.5x slack
 #                           (tail latency is the noisiest honest number
 #                           in the ladder)
+#   stage_*_p99_ms          per-stage pipeline p99 from the loopback
+#                           rung's telemetry-on phase (flight recorder,
+#                           docs/observability.md) — lower is better,
+#                           1.5x slack each (stage tails are at least as
+#                           noisy as the end-to-end p99 they decompose)
+#   telemetry_overhead_ratio  off-phase rate / instrumented-phase rate —
+#                           lower is better (1.0 = free); relative slack
+#                           is generous because the ratio of two noisy
+#                           rates flaps, but the ABSOLUTE_MAX_KEYS cap
+#                           below holds it at 1.05 regardless
 LOWER_BETTER_SLACK = {
     "serve_cpu_ms_per_batch": 1.3,
     "loopback_p99_ms": 1.5,
+    "stage_decode_p99_ms": 1.5,
+    "stage_pack_p99_ms": 1.5,
+    "stage_h2d_p99_ms": 1.5,
+    "stage_tick_p99_ms": 1.5,
+    "stage_encode_p99_ms": 1.5,
+    "telemetry_overhead_ratio": 1.3,
 }
 #   h2d_overlap_ratio       fraction of serving windows whose request
 #                           upload overlapped an earlier window's tick
@@ -99,9 +115,17 @@ HIGHER_BETTER_FLOOR = {
 ABSOLUTE_MIN_KEYS = {
     "h2d_overlap_ratio": 0.5,
 }
+# Absolute ceilings on the candidate, the MIN keys' mirror: telemetry
+# must stay effectively free (≤5% serving-rate cost with the flight
+# recorder installed) no matter what the baseline measured — a baseline
+# that already regressed must not grant the candidate a free pass.
+ABSOLUTE_MAX_KEYS = {
+    "telemetry_overhead_ratio": 1.05,
+}
 
 GATED_VALUE_KEYS = (
     COUNT_KEYS + tuple(LOWER_BETTER_SLACK) + tuple(HIGHER_BETTER_FLOOR)
+    + tuple(ABSOLUTE_MAX_KEYS)
 )
 
 # Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
@@ -317,6 +341,14 @@ def main():
                 failed = True
             print(f"  {key[0]}.{key[1]}: {v:g} "
                   f"(absolute floor {floor:g}, {mark})")
+        ceil = ABSOLUTE_MAX_KEYS.get(key[1])
+        if ceil is not None:
+            gated += 1
+            mark = "FAIL" if v > ceil else "ok"
+            if v > ceil:
+                failed = True
+            print(f"  {key[0]}.{key[1]}: {v:g} "
+                  f"(absolute ceiling {ceil:g}, {mark})")
     for key in sorted(set(base_counts) ^ set(cand_counts)):
         if key in cand_counts and key[1] in ABSOLUTE_ZERO_KEYS:
             # Absolute invariants — a re-promoted key losing its consumed

@@ -500,7 +500,8 @@ def test_repo_hot_path_markers_present():
         # serving window.
         "gubernator_tpu/parallel/mesh_engine.py": [
             "submit_columns", "submit_cols", "submit",
-            "_gregorian_cols", "_resolve_columns", "_account_misses",
+            "_gregorian_cols", "_resolve_columns",
+            "_resolve_columns_locked", "_account_misses",
             "_dispatch_routed", "_dispatch_blocked"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
         # Zero-copy ingest edge: the wire decode/encode and the arena
@@ -508,6 +509,9 @@ def test_repo_hot_path_markers_present():
         "gubernator_tpu/ops/reqcols.py": ["lease"],
         "gubernator_tpu/transport/fastwire.py": ["parse_req",
                                                  "encode_resp"],
+        # Telemetry plane (docs/observability.md): the flight recorder's
+        # record path runs inside every instrumented serving window.
+        "gubernator_tpu/utils/flightrec.py": ["begin", "note", "finish"],
     }
     for path, names in expected.items():
         text = proj.by_path[path].text

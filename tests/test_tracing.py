@@ -202,3 +202,104 @@ def test_traceparent_future_version_with_trailing_fields_accepted():
         {"traceparent": f"01-{tid}-{sid}-01-extradata"}
     ) == SpanContext(tid, sid, 1)
     assert Tracer.extract({"traceparent": f"00-{tid}-{sid}-01-extra"}) is None
+
+
+# ---------------------------------------------------------------------
+# Flight recorder (docs/observability.md): stage accounting on a
+# virtual clock — no daemon, no device, no wall-clock sleeps.
+# ---------------------------------------------------------------------
+def test_flight_recorder_stage_accounting():
+    from gubernator_tpu.resilience.clock import ManualClock
+    from gubernator_tpu.utils import flightrec
+
+    clk = ManualClock(start=100.0)
+    rec = flightrec.FlightRecorder(windows=4, clock=clk)
+    seen = []
+    rec.observer = lambda stage, s: seen.append((stage, round(s, 6)))
+
+    # decode happens before any window exists; it folds into the next
+    # begin().  encode trails the last finished window.
+    rec.edge("decode", 0.001)
+    wid = rec.begin(width=8, depth=2)
+    assert rec.active() == wid
+    rec.note(wid, "lease", 0.0005)
+    rec.note(wid, "pack", 0.002)
+    rec.note(wid, "h2d", 0.003)
+    rec.end_dispatch(wid)
+    assert rec.active() is None
+    rec.note(wid, "tick", 0.004)
+    rec.note(wid, "resolve", 0.001)
+    rec.finish(wid)
+    rec.edge("encode", 0.0015)
+
+    recs = rec.recent()
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["window"] == wid and r["width"] == 8 and r["queue_depth"] == 2
+    assert r["wall"] == 100.0  # stamped from the injected clock
+    assert r["stages_ms"]["decode"] == 1.0   # folded-forward edge
+    assert r["stages_ms"]["encode"] == 1.5   # attached-backward edge
+    assert r["stages_ms"]["pack"] == 2.0
+    assert r["total_ms"] == pytest.approx(13.0)
+    # finish() pushed every nonzero stage through the observer, and the
+    # encode edge reported directly.
+    assert ("pack", 0.002) in seen and ("encode", 0.0015) in seen
+
+    pcts = rec.stage_percentiles()
+    assert pcts["h2d"] == {"p50_ms": 3.0, "p99_ms": 3.0}
+    assert pcts["decode"]["p50_ms"] == 1.0
+
+
+def test_flight_recorder_ring_wrap_and_staleness():
+    from gubernator_tpu.resilience.clock import ManualClock
+    from gubernator_tpu.utils import flightrec
+
+    clk = ManualClock()
+    rec = flightrec.FlightRecorder(windows=4, clock=clk)
+    wids = []
+    for i in range(10):
+        w = rec.begin(width=1, depth=0)
+        rec.note(w, "pack", 0.001 * (i + 1))
+        rec.finish(w)
+        clk.advance(1.0)
+        wids.append(w)
+    # Only the last `windows` records survive the wrap.
+    recs = rec.recent()
+    assert [r["window"] for r in recs] == wids[-4:]
+    # Notes against an evicted window are dropped, not misattributed.
+    rec.note(wids[0], "pack", 99.0)
+    assert all(r["stages_ms"]["pack"] < 90_000 for r in rec.recent())
+    # recent(n) bounds the tail.
+    assert [r["window"] for r in rec.recent(2)] == wids[-2:]
+
+
+def test_flight_recorder_slow_window_watchdog_split():
+    from gubernator_tpu.utils import flightrec
+
+    rec = flightrec.FlightRecorder(windows=8, slow_threshold_s=0.005)
+    fast = rec.begin(width=1, depth=0)
+    rec.note(fast, "pack", 0.001)
+    rec.finish(fast)
+    slow = rec.begin(width=4, depth=1)
+    rec.note(slow, "tick", 0.010)
+    rec.finish(slow)
+
+    assert rec.slow_total == 1
+    dumps = rec.drain_slow()
+    assert [d["window"] for d in dumps] == [slow]
+    assert dumps[0]["stages_ms"]["tick"] == 10.0
+    assert dumps[0]["width"] == 4
+    assert rec.drain_slow() == []  # drained exactly once
+
+
+def test_flight_recorder_global_slot():
+    from gubernator_tpu.utils import flightrec
+
+    assert flightrec.get() is None and not flightrec.enabled()
+    rec = flightrec.FlightRecorder(windows=2)
+    flightrec.install(rec)
+    try:
+        assert flightrec.get() is rec and flightrec.enabled()
+    finally:
+        flightrec.uninstall()
+    assert flightrec.get() is None
