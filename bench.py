@@ -1723,6 +1723,182 @@ def rung_restart_recovery():
 
 
 # ----------------------------------------------------------------------
+# Overload rung: ~10x sustainable load against the admission plane
+# ----------------------------------------------------------------------
+async def _overload_bench():
+    """Saturation acceptance for the admission plane (docs/overload.md):
+    drive the full serving instance far past its sustainable rate with
+    tight propagated budgets and a small bounded queue, and prove the
+    overload control plane degrades instead of collapsing.  Gated keys
+    (scripts/check_bench_regression.py):
+
+      expired_served            requests whose deadline had passed but
+                                were served real answers anyway —
+                                ABSOLUTE_ZERO (a served-after-expiry
+                                answer is wasted device work AND a lie
+                                about the caller's outcome)
+      overload_admitted_p99_ms  p99 latency of requests ADMITTED while
+                                ~10x load was offered (lower-better;
+                                the bounded queue + expiry shed keep it
+                                near the unloaded figure instead of
+                                queueing-delay collapse)
+      overload_goodput_ratio    decisions served within their budget
+                                under overload / the same instance's
+                                unloaded rate (direction-aware floor +
+                                absolute-min 0.7: shed answers are
+                                cheap, so goodput must survive)
+      overload_rss_growth_mb    peak-RSS growth across the overload
+                                phase (ABSOLUTE_MAX: a saturated daemon
+                                must shed, not buffer, the excess)
+    """
+    import resource
+
+    from gubernator_tpu.admission import SHED_EXPIRED_MSG
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+
+    batch = 1000
+    # The leaky/service rungs' table size: the narrow serving program at
+    # this capacity is already XLA-compiled by the earlier rungs, so
+    # this rung pays measurement time, not compile time.
+    n_keys = 1 << 17 if FAST else 1 << 20
+    # Small bounded queue (4 windows) + the AIMD limiter on: saturation
+    # becomes shed decisions within a few windows instead of an
+    # unbounded backlog, and the limiter path is exercised end to end.
+    knobs = {
+        "GUBER_PENDING_LIMIT": str(4 * batch),
+        "GUBER_TARGET_P99_MS": "25",
+        "GUBER_SHED_POLICY": "fail-open",
+    }
+    prev = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        inst = await V1Instance.create(
+            InstanceConfig(behaviors=BehaviorConfig(), cache_size=n_keys)
+        )
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        loop_ = inst.tick_loop
+        rng = np.random.default_rng(23)
+        payloads = [
+            _cols(rng.integers(0, n_keys, batch), 1_000_000, 3_600_000, 0)
+            for _ in range(16)
+        ]
+        for p in payloads[:3]:  # warm: residual compiles, first D2H
+            await inst.get_rate_limits_columns(p)
+
+        # --- Unloaded reference: modest closed-loop concurrency -------
+        async def drive(concurrency, n_calls, budget_s):
+            """Closed-loop clients; returns (served, shed, in_budget,
+            admitted latencies ms, wall seconds).  Served vs shed is
+            decided from the response itself: expired sheds carry the
+            retriable error, fail-open overflow sheds answer
+            remaining == limit (a real decision always consumes its
+            hit, so remaining <= limit - 1)."""
+            served = shed = in_budget = 0
+            lats = []
+            idx = 0
+
+            async def one():
+                nonlocal served, shed, in_budget, idx
+                i = idx = (idx + 1) % len(payloads)
+                deadline = (
+                    time.monotonic() + budget_s if budget_s else None)
+                t0 = time.perf_counter()
+                mat, errs = await inst.get_rate_limits_columns(
+                    payloads[i], deadline=deadline)
+                dt = time.perf_counter() - t0
+                if errs and any(
+                        "request shed" in m for m in errs.values()):
+                    shed += len(errs)
+                    served += mat.shape[1] - len(errs)
+                elif bool((mat[2] == 1_000_000).all()):
+                    shed += mat.shape[1]  # fail-open policy answers
+                else:
+                    served += mat.shape[1]
+                    lats.append(dt * 1e3)
+                    if budget_s is None or dt <= budget_s:
+                        in_budget += mat.shape[1]
+
+            sem = asyncio.Semaphore(concurrency)
+
+            async def worker():
+                async with sem:
+                    await one()
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(worker() for _ in range(n_calls)))
+            return served, shed, in_budget, lats, time.perf_counter() - t0
+
+        n_ref = 24 if FAST else 96
+        ref_served, _, _, ref_lats, ref_dt = await drive(4, n_ref, None)
+        unloaded_rate = ref_served / max(ref_dt, 1e-9)
+        _, ref_p99 = _pcts(ref_lats)
+
+        # --- Pre-expired probe: the ABSOLUTE_ZERO invariant -----------
+        # Requests whose budget is already spent at submit time must be
+        # shed with the retriable error, never answered for real.
+        expired_extra = 0
+        for i in range(4):
+            mat, errs = await inst.get_rate_limits_columns(
+                payloads[i], deadline=time.monotonic() - 1.0)
+            expired_extra += sum(
+                1 for j in range(mat.shape[1])
+                if errs.get(j) != SHED_EXPIRED_MSG
+            )
+
+        # --- Overload: ~10x the sustainable closed-loop concurrency ---
+        # Budgets sized a few unloaded-p99s out: long enough that an
+        # admitted window completes, short enough that a deep backlog
+        # expires in the queue instead of being served late.
+        budget_s = max(4 * ref_p99 / 1e3, 0.05)
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        shed0 = dict(loop_.metric_shed_admission)
+        n_over = 120 if FAST else 480
+        served, shed, in_budget, lats, over_dt = await drive(
+            40, n_over, budget_s)
+        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        _, over_p99 = _pcts(lats or [0.0])
+        goodput = in_budget / max(over_dt, 1e-9)
+        shed_delta = {
+            k: loop_.metric_shed_admission.get(k, 0) - shed0.get(k, 0)
+            for k in loop_.metric_shed_admission
+        }
+        return {
+            "rung": "overload_shed",
+            "keys": n_keys,
+            "batch": batch,
+            "measured": True,
+            "unloaded_rate": round(unloaded_rate, 1),
+            "unloaded_p99_ms": round(ref_p99, 3),
+            "offered_vs_served": round(
+                (served + shed) / max(served, 1), 2),
+            "decisions_per_sec": round(goodput, 1),
+            "overload_goodput_ratio": round(
+                goodput / max(unloaded_rate, 1e-9), 4),
+            "overload_admitted_p99_ms": round(over_p99, 3),
+            "expired_served": int(
+                loop_.metric_expired_served + expired_extra),
+            "shed_total": int(sum(shed_delta.values())),
+            "shed_by_reason": {k: int(v) for k, v in shed_delta.items()},
+            "window_limit_final": loop_.limiter.window_limit,
+            "limiter_decreases": loop_.limiter.metric_decreases,
+            "overload_rss_growth_mb": round((rss1 - rss0) / 1024.0, 1),
+        }
+    finally:
+        await inst.close()
+
+
+def rung_overload():
+    return asyncio.run(_overload_bench())
+
+
+# ----------------------------------------------------------------------
 # Sharded-table mesh rung (8 virtual devices, CPU backend, subprocess)
 # ----------------------------------------------------------------------
 def child_mesh_tick():
@@ -2358,6 +2534,9 @@ def main():
         ladder.append(_safe("engine_100m_drain_reset_region", rung_100m))
 
     ladder.append(_safe("service_grpc", rung_service))
+    # Right after the service rung: the overload rung reuses its
+    # already-compiled narrow serving program at the same capacity.
+    ladder.append(_safe("overload_shed", rung_overload))
     ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
@@ -2536,6 +2715,11 @@ def compact_headline(record, ladder_file):
         # efficiency is direction-aware (must not decay vs baseline).
         "mesh_routing_parity_errors", "mesh_dropped_keys",
         "mesh_double_served", "mesh_scaling_efficiency",
+        # Overload control gates (docs/overload.md): expired-but-served
+        # is ABSOLUTE_ZERO, admitted p99 is lower-better, goodput under
+        # ~10x load must hold its floor, RSS growth is bounded.
+        "expired_served", "overload_admitted_p99_ms",
+        "overload_goodput_ratio", "overload_rss_growth_mb",
     )
     count_map = {}
     for r in record["ladder"]:

@@ -85,6 +85,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_GRPC_MAX_CONN_AGE_SEC": "max gRPC client connection age (0 = inf)",
     "GUBER_HTTP_ADDRESS": "HTTP/JSON gateway listen address",
     "GUBER_INGEST_ARENA_SLABS": "preallocated wire-decode column slabs (0 = off)",
+    "GUBER_INGEST_FALLBACK_LIMIT": "arena-miss plain allocations per window before shed",
     "GUBER_INSTANCE_ID": "unique instance id for logs/tracing",
     "GUBER_K8S_ENDPOINTS_SELECTOR": "k8s discovery: endpoints selector",
     "GUBER_K8S_NAMESPACE": "k8s discovery: namespace",
@@ -102,14 +103,19 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_PEER_DISCOVERY_TYPE": "discovery pool: member-list/etcd/dns/k8s/none",
     "GUBER_PEER_PICKER": "peer picker implementation",
     "GUBER_PEER_PICKER_HASH": "picker hash: fnv1 or fnv1a",
+    "GUBER_PEER_TIMEOUT_FLOOR": "min peer RPC timeout under deadline propagation",
+    "GUBER_PENDING_LIMIT": "bounded admission queue cap in requests (0 = auto)",
     "GUBER_REDELIVERY_LIMIT": "GLOBAL redelivery buffer cap",
     "GUBER_REPLICATED_HASH_REPLICAS": "consistent-hash virtual replicas",
+    "GUBER_REQUEST_TIMEOUT": "default per-request deadline budget",
     "GUBER_RESOLV_CONF": "dns discovery: resolv.conf path",
+    "GUBER_SHED_POLICY": "overload shed answers: fail-open/fail-closed",
     "GUBER_SLOW_WINDOW_MS": "slow-window watchdog threshold in ms (0 = off)",
     "GUBER_SNAPSHOT_DELTAS_PER_BASE": "delta records per base compaction",
     "GUBER_SNAPSHOT_DIR": "crash-safe snapshot directory ('' = off)",
     "GUBER_SNAPSHOT_INTERVAL": "delta snapshot cadence (seconds)",
     "GUBER_STATUS_HTTP_ADDRESS": "no-mTLS health/metrics listener",
+    "GUBER_TARGET_P99_MS": "AIMD limiter window-p99 target in ms (0 = off)",
     "GUBER_TICK_PIPELINE_DEPTH": "dispatched-unresolved tick windows in flight",
     "GUBER_TLS_AUTO": "self-signed server TLS",
     "GUBER_TLS_CA": "TLS CA cert file",

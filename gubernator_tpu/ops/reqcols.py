@@ -222,6 +222,14 @@ def key_blob_from_parts(
 # ----------------------------------------------------------------------
 # Ingest column arena: preallocated per-window decode slabs
 # ----------------------------------------------------------------------
+class IngestOverloadError(RuntimeError):
+    """Every arena slab is busy AND the per-window plain-allocation
+    fallback budget (GUBER_INGEST_FALLBACK_LIMIT) is spent.  The ingest
+    edge answers this as backpressure — a retriable RESOURCE_EXHAUSTED
+    shed — instead of letting overload grow the heap unboundedly
+    (docs/overload.md)."""
+
+
 class ArenaLease:
     """One leased slab of a :class:`ColumnArena` (views handed to the
     decoder plus the release token).  Thread-safe release; idempotent."""
@@ -269,7 +277,8 @@ class ColumnArena:
     # wild run tens of bytes, and oversized batches just fall back.
     BLOB_PER_ROW = 128
 
-    def __init__(self, max_batch: int, slabs: int = 8):
+    def __init__(self, max_batch: int, slabs: int = 8,
+                 fallback_limit: int = 32):
         self.max_batch = int(max_batch)
         self.n_slabs = max(1, int(slabs))
         self.blob_cap = self.max_batch * self.BLOB_PER_ROW
@@ -280,10 +289,19 @@ class ColumnArena:
         self._busy = [False] * self.n_slabs
         self._next = 0
         self._lock = threading.Lock()
+        # Busy-slab plain-allocation fallback budget, per window: the
+        # counter resets whenever a slab recycles (a window completed),
+        # so sustained exhaustion — not a transient burst — is what
+        # exhausts the budget and triggers shed (docs/overload.md).
+        self.fallback_limit = max(0, int(fallback_limit))
+        self._window_fallbacks = 0
         # Telemetry: misses (all slabs busy / batch too big) say whether
-        # the bound is sized to the deployment's concurrency.
+        # the bound is sized to the deployment's concurrency;
+        # fallbacks count the budgeted plain allocations taken while
+        # every slab was busy (gubernator_tpu_arena_fallbacks).
         self.metric_leases = 0
         self.metric_misses = 0
+        self.metric_fallbacks = 0
 
     @hot_path
     def lease(self, n: int, blob_cap: int) -> Optional[ArenaLease]:
@@ -315,9 +333,29 @@ class ColumnArena:
         flags[:n] = 0
         return ArenaLease(self, idx, ints, flags, self._blob[idx])
 
+    def fits(self, n: int, blob_cap: int) -> bool:
+        """Whether an ``n``-row decode could EVER lease here — False is a
+        size miss (plain allocation is the only option and stays
+        uncapped); True with a failed lease is busy-slab exhaustion,
+        which is what the fallback budget governs."""
+        return n <= self.max_batch and blob_cap <= self.blob_cap
+
+    @hot_path
+    def try_fallback(self) -> bool:
+        """Spend one unit of the per-window plain-allocation budget.
+        False means the budget is gone: the caller sheds with
+        :class:`IngestOverloadError` semantics instead of allocating."""
+        with self._lock:
+            if self._window_fallbacks >= self.fallback_limit:
+                return False
+            self._window_fallbacks += 1
+            self.metric_fallbacks += 1
+            return True
+
     def _release(self, index: int) -> None:
         with self._lock:
             self._busy[index] = False
+            self._window_fallbacks = 0
 
     def in_use(self) -> int:
         with self._lock:

@@ -24,6 +24,12 @@ from typing import List, Optional, Sequence
 
 import grpc
 
+from gubernator_tpu.admission import (
+    CLASS_CLIENT,
+    CLASS_PEER,
+    BudgetExhaustedError,
+    batch_deadline,
+)
 from gubernator_tpu.config import BehaviorConfig, Config
 from gubernator_tpu.resilience import (
     BreakerOpenError,
@@ -220,8 +226,15 @@ class V1Instance:
             slabs = env_knob("GUBER_INGEST_ARENA_SLABS", 8, parse=int)
         except ValueError:
             slabs = 8
+        try:
+            fallback_limit = env_knob(
+                "GUBER_INGEST_FALLBACK_LIMIT", 32, parse=int)
+        except ValueError:
+            fallback_limit = 32
         self.ingest_arena = (
-            ColumnArena(MAX_BATCH_SIZE, slabs=slabs) if slabs > 0 else None
+            ColumnArena(MAX_BATCH_SIZE, slabs=slabs,
+                        fallback_limit=fallback_limit)
+            if slabs > 0 else None
         )
         hash_fn = HASH_FUNCTIONS[conf.picker_hash]
         self._standalone = True  # no peers installed yet; see set_peers
@@ -483,32 +496,38 @@ class V1Instance:
             and hasattr(self.engine, "submit_cols")
         )
 
-    async def get_rate_limits_columns(self, cols):
+    async def get_rate_limits_columns(self, cols, deadline: float = None):
         """Columnar GetRateLimits (the fast path; see
         columns_fast_path_ok).  Returns ``((5, n) matrix, errors)`` in
         request order; the transport writes wire responses straight from
-        the matrix."""
+        the matrix.  ``deadline`` is the batch's absolute admission
+        deadline stamped at the serving edge (docs/overload.md)."""
         if len(cols) > MAX_BATCH_SIZE:
             self.metrics.check_error_counter.labels(error="Request too large").inc()
             raise BatchTooLargeError(
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
             )
-        return await self._columns_tick(cols)
+        return await self._columns_tick(cols, deadline=deadline)
 
-    async def _columns_tick(self, cols, public: bool = True):
+    async def _columns_tick(self, cols, public: bool = True,
+                            deadline: float = None):
         """One tick-loop submission for a columnar batch + metrics.
 
         ``public`` marks the public GetRateLimits edge, which alone
         carries the concurrent-checks gauge and the GetRateLimits
         duration family (reference gubernator.go:188-199); the peer
         relay edge records only the local-handling metrics its object
-        path does (_submit_local)."""
+        path does (_submit_local).  It also picks the admission class:
+        relayed peer batches outrank client traffic under overload."""
         if public:
             self.metrics.concurrent_checks.inc()
         t0 = time.perf_counter()
         try:
             mat, errors = await asyncio.wrap_future(
-                self.tick_loop.submit_columns(cols)
+                self.tick_loop.submit_columns(
+                    cols, deadline=deadline,
+                    klass=CLASS_CLIENT if public else CLASS_PEER,
+                )
             )
             self.metrics.getratelimit_counter.labels(calltype="local").inc(
                 len(cols) - len(errors)
@@ -530,13 +549,16 @@ class V1Instance:
                 name="V1Instance.getLocalRateLimit"
             ).observe(dt)
 
-    def _submit_local(self, reqs: List[RateLimitRequest], *, is_owner: bool):
+    def _submit_local(self, reqs: List[RateLimitRequest], *, is_owner: bool,
+                      klass: int = CLASS_CLIENT):
         """Send a batch through the tick loop; wraps the future for await and
-        handles GLOBAL owner-side queueing + metrics."""
+        handles GLOBAL owner-side queueing + metrics.  The batch inherits
+        its most urgent member's propagated deadline (docs/overload.md)."""
 
         async def run():
             t0 = time.perf_counter()
-            resps = await asyncio.wrap_future(self.tick_loop.submit(reqs))
+            resps = await asyncio.wrap_future(self.tick_loop.submit(
+                reqs, deadline=batch_deadline(reqs), klass=klass))
             self.metrics.func_duration.labels(
                 name="V1Instance.getLocalRateLimit"
             ).observe(time.perf_counter() - t0)
@@ -555,10 +577,12 @@ class V1Instance:
         self, reqs: List[RateLimitRequest]
     ) -> List[RateLimitResponse]:
         """Apply requests to the local engine with no routing/queueing — the
-        GLOBAL manager's state re-read path (global.go:241-249)."""
+        GLOBAL manager's state re-read path (global.go:241-249).  Peer
+        admission class: reconcile traffic outranks client traffic."""
         t0 = time.perf_counter()
         try:
-            return await asyncio.wrap_future(self.tick_loop.submit(reqs))
+            return await asyncio.wrap_future(self.tick_loop.submit(
+                reqs, deadline=batch_deadline(reqs), klass=CLASS_PEER))
         finally:
             self.metrics.func_duration.labels(
                 name="V1Instance.getLocalRateLimit"
@@ -579,7 +603,8 @@ class V1Instance:
             c.behavior = set_behavior(c.behavior, Behavior.NO_BATCHING, True)
             c.behavior = set_behavior(c.behavior, Behavior.GLOBAL, False)
             clones.append(c)
-        resps = await asyncio.wrap_future(self.tick_loop.submit(clones))
+        resps = await asyncio.wrap_future(self.tick_loop.submit(
+            clones, deadline=batch_deadline(clones)))
         for r in reqs:
             self.global_mgr.queue_hit(r)
             self.metrics.getratelimit_counter.labels(calltype="global").inc()
@@ -683,11 +708,33 @@ class V1Instance:
                     error=f"GetPeer() keeps returning peers that are not "
                     f"connected for '{key}': {last_err}"
                 )
+            # Deadline-aware retry budget (docs/overload.md): once the
+            # caller's propagated budget is spent, stop riding the
+            # backoff ladder — the client already gave up; answer a
+            # retriable error instead of hammering a dead peer.
+            if (
+                attempts != 0
+                and req.deadline is not None
+                and time.monotonic() >= req.deadline
+            ):
+                self.metrics.check_error_counter.labels(
+                    error="Deadline exceeded").inc()
+                return RateLimitResponse(
+                    error=f"deadline budget spent while forwarding "
+                    f"'{key}': {last_err}"
+                )
             if attempts != 0 and peer.info.is_owner:
                 resps = await self._submit_local([req], is_owner=True)
                 return resps[0]
             try:
                 resp = await peer.get_peer_rate_limit(req)
+            except BudgetExhaustedError as e:
+                self.metrics.check_error_counter.labels(
+                    error="Deadline exceeded").inc()
+                return RateLimitResponse(
+                    error=f"deadline budget spent while forwarding "
+                    f"'{key}': {e}"
+                )
             except BreakerOpenError as e:
                 if has_behavior(req.behavior, Behavior.GLOBAL):
                     # Degraded mode: the non-owner GLOBAL state is a
@@ -740,17 +787,18 @@ class V1Instance:
             and hasattr(self.engine, "submit_cols")
         )
 
-    async def get_peer_rate_limits_columns(self, cols):
+    async def get_peer_rate_limits_columns(self, cols, deadline: float = None):
         """Columnar owner-side handling of a relayed batch (the peer-edge
         twin of get_rate_limits_columns; eligibility per
-        peer_columns_fast_path_ok)."""
+        peer_columns_fast_path_ok).  Peer admission class: relayed
+        reconcile traffic outranks client traffic under overload."""
         if len(cols) > MAX_BATCH_SIZE:
             self.metrics.check_error_counter.labels(error="Request too large").inc()
             raise BatchTooLargeError(
                 f"'PeerRequest.rate_limits' list too large; max size is "
                 f"'{MAX_BATCH_SIZE}'"
             )
-        return await self._columns_tick(cols, public=False)
+        return await self._columns_tick(cols, public=False, deadline=deadline)
 
     async def get_peer_rate_limits(
         self, requests: Sequence[RateLimitRequest]
@@ -786,7 +834,8 @@ class V1Instance:
             if req.created_at is None or req.created_at == 0:
                 req.created_at = created_at
         try:
-            return await self._submit_local(list(requests), is_owner=True)
+            return await self._submit_local(
+                list(requests), is_owner=True, klass=CLASS_PEER)
         finally:
             for s in spans:
                 tracer.finish(s)

@@ -29,7 +29,13 @@ from typing import List, Optional, Sequence
 import grpc
 import grpc.aio
 
-from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.admission import (
+    DEADLINE_METADATA_KEY,
+    BudgetExhaustedError,
+    batch_deadline,
+    budget_header_value,
+)
+from gubernator_tpu.config import BehaviorConfig, env_knob, parse_duration
 from gubernator_tpu.pb import peers_pb2 as peers_pb
 from gubernator_tpu.resilience import (
     BreakerOpenError,
@@ -93,6 +99,15 @@ class PeerClient:
         self.behaviors = behaviors or BehaviorConfig()
         self.credentials = channel_credentials
         self.metrics = metrics
+        # Deadline propagation (docs/overload.md): RPC timeouts derive
+        # from the forwarded request's remaining budget, floored so a
+        # nearly-spent budget still gets one real attempt on the wire.
+        self._clock = clock
+        try:
+            self.timeout_floor = env_knob(
+                "GUBER_PEER_TIMEOUT_FLOOR", 0.05, parse=parse_duration)
+        except ValueError:
+            self.timeout_floor = 0.05
         self.last_errs = ErrorRecorder()
         self.resilience = resilience or ResilienceConfig()
         self.faults = fault_injector
@@ -201,11 +216,38 @@ class PeerClient:
         await q.put((req, fut))
         return await fut
 
+    def rpc_budget(
+        self, reqs: Sequence[RateLimitRequest]
+    ) -> tuple:
+        """(RPC timeout, ``guber-deadline-ms`` header value) for one
+        forwarded batch: the earliest propagated remaining budget,
+        floored by GUBER_PEER_TIMEOUT_FLOOR (a nearly-spent budget still
+        gets one real wire attempt) and capped by ``batch_timeout``.  No
+        propagated deadline → the fixed ``batch_timeout`` and no header.
+        Raises :class:`BudgetExhaustedError` when the budget is already
+        spent — the RPC must not be attempted at all."""
+        deadline = batch_deadline(reqs)
+        if deadline is None:
+            return self.behaviors.batch_timeout, None
+        now = self._clock()
+        remaining = deadline - now
+        if remaining <= 0:
+            raise BudgetExhaustedError(
+                "caller budget spent before forwarding to "
+                f"{self._info.grpc_address}"
+            )
+        timeout = min(
+            self.behaviors.batch_timeout,
+            max(remaining, self.timeout_floor),
+        )
+        return timeout, budget_header_value(deadline, now)
+
     async def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitRequest]
     ) -> List[RateLimitResponse]:
         """One unbatched GetPeerRateLimits RPC; responses in request order."""
         addr = self._info.grpc_address
+        timeout, budget = self.rpc_budget(reqs)
         if not self.breaker.allow():
             msg_ = f"circuit breaker open for peer {addr}"
             self.last_errs.record(msg_)
@@ -215,15 +257,19 @@ class PeerClient:
             requests=[convert.req_to_pb(r) for r in reqs]
         )
         # gRPC-level trace header for the server interceptor; per-request
-        # metadata already carries each caller's own context.
+        # metadata already carries each caller's own context.  The
+        # remaining deadline budget rides along so the peer's admission
+        # plane sheds what this caller can no longer wait for.
         hdrs: dict = {}
         tracing.inject(hdrs)
+        if budget is not None:
+            hdrs[DEADLINE_METADATA_KEY] = budget
         try:
             if self.faults is not None:
                 await self.faults.before_rpc(addr, "GetPeerRateLimits")
             out = await stub.GetPeerRateLimits(
                 msg,
-                timeout=self.behaviors.batch_timeout,
+                timeout=timeout,
                 metadata=tuple(hdrs.items()) or None,
             )
         except grpc.aio.AioRpcError as e:

@@ -27,7 +27,17 @@ from typing import List, Sequence
 
 import numpy as np
 
-from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+from gubernator_tpu.admission import (
+    CLASS_CLIENT,
+    POLICY_FAIL_CLOSED,
+    SHED_EXPIRED_MSG,
+    SHED_SHUTDOWN_MSG,
+    AdmissionConfig,
+    AdmissionQueue,
+    AimdLimiter,
+    QueueItem,
+)
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse, Status
 from gubernator_tpu.utils import flightrec
 from gubernator_tpu.utils.hotpath import hot_path
 
@@ -92,12 +102,30 @@ class TickLoop:
         batch_limit: int = 1000,
         metrics=None,
         pipeline_depth: int = None,
+        admission: AdmissionConfig = None,
+        clock=time.monotonic,
     ):
         self.engine = engine
         self.batch_wait = float(batch_wait)
         self.batch_limit = int(batch_limit)
         self.metrics = metrics
         self.pipeline_depth = resolve_pipeline_depth(pipeline_depth)
+        # Overload control plane (docs/overload.md).  The injected clock
+        # drives ONLY deadline math (ManualClock in tests); the batch
+        # window below stays on real time so a frozen test clock cannot
+        # wedge the dispatch thread's timed wait.
+        self.admission = (
+            admission if admission is not None else AdmissionConfig.from_env()
+        )
+        self._clock = clock
+        self.shed_policy = self.admission.shed_policy
+        self.limiter = AimdLimiter(
+            self.admission.target_p99_ms, max_limit=self.batch_limit)
+        self._queue = AdmissionQueue(
+            self.admission.effective_pending_limit(self.batch_limit))
+        self.metric_shed_admission = {}  # reason -> shed request count
+        self.metric_expired_served = 0  # invariant: stays 0
+        self._synced_expired_served = 0
         # Engine counter mirrors already synced into prometheus families
         # (the engine counts in plain ints; deltas flow here per tick).
         self._synced_hits = 0
@@ -110,7 +138,6 @@ class TickLoop:
         self._synced_routed = 0
         self._synced_routed_overflows = 0
         self._cond = threading.Condition()
-        self._pending: List[tuple] = []  # (requests, future)
         self._pending_count = 0
         self._running = True
         self._resolve_q: "queue.Queue" = queue.Queue(
@@ -125,19 +152,28 @@ class TickLoop:
         self._resolver.start()
 
     def submit(
-        self, requests: Sequence[RateLimitRequest]
+        self,
+        requests: Sequence[RateLimitRequest],
+        deadline: float = None,
+        klass: int = CLASS_CLIENT,
     ) -> "Future[List[RateLimitResponse]]":
-        """Queue a request batch for the next tick."""
-        return self._enqueue("obj", list(requests), len(requests))
+        """Queue a request batch for the next tick.  ``deadline`` is the
+        batch's absolute admission deadline on this loop's clock (None =
+        never shed); ``klass`` is the admission class (peer reconcile
+        traffic outranks client traffic under overload)."""
+        return self._enqueue("obj", list(requests), len(requests),
+                             deadline, klass)
 
-    def submit_columns(self, cols) -> "Future":
+    def submit_columns(self, cols, deadline: float = None,
+                       klass: int = CLASS_CLIENT) -> "Future":
         """Queue a columnar batch; the future resolves to the
         ``((5, n) matrix, errors)`` pair — no response objects anywhere
         on the path (the transport fast path; engine must expose
         submit_cols)."""
-        return self._enqueue("cols", cols, len(cols))
+        return self._enqueue("cols", cols, len(cols), deadline, klass)
 
-    def _enqueue(self, kind: str, payload, n: int) -> Future:
+    def _enqueue(self, kind: str, payload, n: int, deadline: float = None,
+                 klass: int = CLASS_CLIENT) -> Future:
         fut: Future = Future()
         if n == 0:
             fut.set_result(
@@ -148,22 +184,29 @@ class TickLoop:
             if not self._running:
                 fut.set_exception(RuntimeError("tick loop is shut down"))
                 return fut
-            self._pending.append((kind, payload, n, fut))
-            self._pending_count += n
+            item = QueueItem(kind, payload, n, fut, deadline, klass)
+            shed = self._queue.push(item)
+            self._pending_count = self._queue.requests
             if self.metrics is not None:
                 self.metrics.worker_queue_length.labels(
                     method="GetRateLimits", worker="0"
                 ).set(self._pending_count)
+                self.metrics.admission_queue_depth.set(self._pending_count)
             self._cond.notify()
+        # Answer overflow victims outside the lock: they are already
+        # unlinked from the queue, and shed answers may release arena
+        # leases / complete futures with waiting callbacks.
+        for victim in shed:
+            self._shed_item(victim, "overflow")
         return fut
 
     @hot_path
     def _run(self) -> None:
         while True:
             with self._cond:
-                while self._running and not self._pending:
+                while self._running and not self._queue:
                     self._cond.wait()
-                if not self._running and not self._pending:
+                if not self._running and not self._queue:
                     self._resolve_q.put(None)  # drain + stop the resolver
                     return
                 # Batch window: once something is queued, wait out the tick
@@ -177,35 +220,58 @@ class TickLoop:
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=remaining)
-                batch = self._pending
-                self._pending = []
-                self._pending_count = 0
+                # Admitted window width: the AIMD limiter narrows it under
+                # measured saturation; shutdown drains at full width so a
+                # throttled loop still closes promptly.  Whatever does not
+                # fit stays queued (in priority order) for the next tick.
+                width = self.batch_limit
+                if self._running and self.limiter.enabled:
+                    width = min(width, self.limiter.window_limit)
+                batch = self._queue.pop_window(width)
+                self._pending_count = self._queue.requests
             self._flush(batch)
 
     @hot_path
-    def _flush(self, batch: List[tuple]) -> None:
+    def _flush(self, batch: List[QueueItem]) -> None:
         """Dispatch one window.  Object and columnar submissions each
         coalesce into (at most) one engine submission; both ride the same
         resolver handoff and resolve together in one D2H."""
+        # Deadline-aware admission (docs/overload.md): shed anything
+        # already expired BEFORE packing — the device never burns a tick
+        # answering an RPC whose caller has given up.  Shed items are
+        # answered with a retriable error status, never dropped.
+        now = self._clock()
+        expired = [it for it in batch if it.expired(now)]
+        if expired:
+            batch = [it for it in batch if not it.expired(now)]
+            for it in expired:
+                self._shed_item(it, "expired")
+        if not batch:
+            return
         # Flight-recorder window open (docs/observability.md): the engine
         # notes lease/pack/h2d into the active window while we dispatch.
         fr = flightrec.get()
         wid = None
         if fr is not None:
             wid = fr.begin(
-                sum(n for _, _, n, _ in batch), self._resolve_q.qsize())
+                sum(it.n for it in batch), self._resolve_q.qsize())
         t0 = time.perf_counter()
         obj_items: List[tuple] = []   # (n, fut)
         reqs: List[RateLimitRequest] = []
         col_parts: List = []
         col_items: List[tuple] = []
-        for kind, payload, n, fut in batch:
-            if kind == "cols":
-                col_parts.append(payload)
-                col_items.append((n, fut))
+        for it in batch:
+            # Invariant counter for the overload_shed gate: an expired
+            # item reaching the pack stage means the partition above
+            # regressed.  Counted (and exported), never silently served.
+            if it.expired(now):
+                self.metric_expired_served += it.n
+            if it.kind == "cols":
+                col_parts.append(it.payload)
+                col_items.append((it.n, it.fut))
             else:
-                reqs.extend(payload)
-                obj_items.append((n, fut))
+                reqs.extend(it.payload)
+                obj_items.append((it.n, it.fut))
 
         # Every engine (single-chip TickEngine AND the sharded
         # MeshTickEngine) speaks the dispatch/resolve split: submissions
@@ -347,10 +413,79 @@ class TickLoop:
             _complete(fut, out[off : off + n])
             off += n
 
+    def _shed_item(self, item: QueueItem, reason: str) -> None:
+        """Answer one shed submission (docs/overload.md).  Expired and
+        shutdown sheds answer a retriable per-item error so callers know
+        to retry with a fresh budget / against another peer; overflow
+        sheds answer the configured degradation policy (fail-open
+        UNDER_LIMIT with full remaining, fail-closed OVER_LIMIT with
+        zero remaining).  Columnar payloads release their arena lease
+        here — a shed batch must not pin a decode slab."""
+        self.metric_shed_admission[reason] = (
+            self.metric_shed_admission.get(reason, 0) + item.n)
+        if self.metrics is not None:
+            self.metrics.admission_shed.labels(reason=reason).inc(item.n)
+        retriable = reason in ("expired", "shutdown")
+        msg = SHED_EXPIRED_MSG if reason == "expired" else SHED_SHUTDOWN_MSG
+        if item.kind == "obj":
+            if retriable:
+                out = [RateLimitResponse(error=msg)
+                       for _ in range(item.n)]
+            else:
+                out = [self._policy_response(r) for r in item.payload]
+            _complete(item.fut, out)
+            return
+        cols = item.payload
+        try:
+            if retriable:
+                mat = np.zeros((5, item.n), np.int64)
+                errs = {i: msg for i in range(item.n)}
+            else:
+                mat = self._policy_matrix(cols, item.n)
+                errs = {}
+        finally:
+            cols.release()
+        _complete(item.fut, (mat, errs))
+
+    def _policy_response(self, r: RateLimitRequest) -> RateLimitResponse:
+        reset = (getattr(r, "created_at", 0) or 0) + (r.duration or 0)
+        if self.shed_policy == POLICY_FAIL_CLOSED:
+            return RateLimitResponse(
+                status=Status.OVER_LIMIT, limit=r.limit,
+                remaining=0, reset_time=reset)
+        return RateLimitResponse(
+            status=Status.UNDER_LIMIT, limit=r.limit,
+            remaining=r.limit, reset_time=reset)
+
+    def _policy_matrix(self, cols, n: int) -> np.ndarray:
+        """Degradation answers for a shed columnar batch, built from the
+        request columns BEFORE the arena lease is recycled (rows: status,
+        limit, remaining, reset_time, over_limit)."""
+        mat = np.zeros((5, n), np.int64)
+        mat[1] = cols.limit
+        mat[3] = cols.created_at + cols.duration
+        if self.shed_policy == POLICY_FAIL_CLOSED:
+            mat[0] = int(Status.OVER_LIMIT)
+            mat[4] = 1
+        else:
+            mat[2] = cols.limit
+        return mat
+
     def _metrics_sync(self, n_reqs: int, tick_s: float) -> None:
+        # AIMD feedback (docs/overload.md): every resolved window's own
+        # engine time (dispatch + resolve) is one limiter sample.
+        self.limiter.record(tick_s * 1000.0)
         if self.metrics is None:
             return
         m = self.metrics
+        m.admission_window_limit.set(
+            self.limiter.window_limit if self.limiter.enabled
+            else self.batch_limit)
+        m.admission_queue_depth.set(self._pending_count)
+        if self.metric_expired_served > self._synced_expired_served:
+            m.admission_expired_served.inc(
+                self.metric_expired_served - self._synced_expired_served)
+            self._synced_expired_served = self.metric_expired_served
         m.tick_duration.observe(tick_s)
         m.tick_batch_size.observe(n_reqs)
         m.worker_queue_length.labels(
@@ -435,6 +570,11 @@ class TickLoop:
             self._resolve_q.put(None)
 
     def close(self) -> None:
+        """Shut down, draining the bounded queue deadline-aware: the
+        dispatch thread flushes the backlog through ``_flush`` (which
+        sheds expired work) before exiting; if it is wedged, everything
+        still queued is answered with a retriable shed status instead of
+        being abandoned behind a fixed join timeout."""
         with self._cond:
             self._running = False
             self._cond.notify()
@@ -442,15 +582,16 @@ class TickLoop:
         if self._thread.is_alive():
             # Dispatch thread wedged (e.g. blocked on a full resolve queue
             # with a dead resolver): don't hang close() — but don't leave
-            # queued waiters hanging forever either; fail everything
-            # still pending so callers awaiting wrap_future() return.
+            # queued waiters hanging forever either; answer everything
+            # still pending so callers awaiting wrap_future() return and
+            # know to retry elsewhere.
             with self._cond:
-                stuck = self._pending
-                self._pending = []
+                stuck = self._queue.drain()
                 self._pending_count = 0
-            err = RuntimeError("tick loop shut down with requests pending")
-            _fail_waiters([(n, fut) for _, _, n, fut in stuck], err)
-            self._drain_resolve_q(err)
+            for item in stuck:
+                self._shed_item(item, "shutdown")
+            self._drain_resolve_q(
+                RuntimeError("tick loop shut down with requests pending"))
             return
         self._resolver.join(timeout=5)
         if self._resolver.is_alive():

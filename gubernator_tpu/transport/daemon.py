@@ -21,7 +21,12 @@ import grpc.aio
 from aiohttp import web
 from google.protobuf import json_format
 
+from gubernator_tpu.admission import (
+    DEADLINE_METADATA_KEY,
+    deadline_from_header,
+)
 from gubernator_tpu.config import DaemonConfig, env_knob
+from gubernator_tpu.ops.reqcols import IngestOverloadError
 from gubernator_tpu.pb import gubernator_pb2 as pb
 from gubernator_tpu.pb import peers_pb2 as peers_pb
 from gubernator_tpu.resilience.supervisor import spawn_supervised
@@ -145,8 +150,50 @@ def _item_responses(mat, errs):
     ]
 
 
+def _edge_deadline(context, default_timeout: float):
+    """The absolute local admission deadline for one inbound RPC
+    (docs/overload.md): an explicit ``guber-deadline-ms`` budget header
+    wins (peer hops propagate remaining budget this way, clock-skew
+    free), else the caller's own gRPC deadline, else the
+    GUBER_REQUEST_TIMEOUT default.  None (never shed) only when the
+    default is 0."""
+    now = time.monotonic()
+    value = None
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == DEADLINE_METADATA_KEY:
+                value = v
+                break
+    except Exception:
+        pass
+    d = deadline_from_header(value, now)
+    if d is not None:
+        return d
+    try:
+        rem = context.time_remaining()
+    except Exception:
+        rem = None
+    if rem is not None:
+        return now + rem
+    if default_timeout > 0:
+        return now + default_timeout
+    return None
+
+
+def _sync_arena_metrics(arena, metrics) -> None:
+    """Mirror the arena's plain-int fallback counter into the
+    gubernator_tpu_arena_fallbacks family (delta sync, the tick loop's
+    engine-counter pattern)."""
+    if arena is None or metrics is None:
+        return
+    synced = getattr(arena, "_synced_fallbacks", 0)
+    if arena.metric_fallbacks > synced:
+        metrics.arena_fallbacks.inc(arena.metric_fallbacks - synced)
+        arena._synced_fallbacks = arena.metric_fallbacks
+
+
 async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
-                            arena=None):
+                            arena=None, deadline=None, metrics=None):
     """The shared raw-bytes fast path of both rate-limit edges: native
     wire parse → columns → device tick → native wire encode, with no
     protobuf objects.  Returns ``(result, msg)``: ``result`` is the
@@ -165,7 +212,16 @@ async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
         # (folded into window records — see utils/flightrec.py).
         fr = flightrec.get()
         t0 = time.perf_counter() if fr is not None else 0.0
-        parsed = fastwire.parse_req(raw, arena)
+        try:
+            parsed = fastwire.parse_req(raw, arena)
+        except IngestOverloadError as e:
+            # Bounded ingest (docs/overload.md): arena exhaustion past
+            # the fallback budget is backpressure, not an allocation —
+            # answer retriable RESOURCE_EXHAUSTED so clients back off.
+            if metrics is not None:
+                metrics.admission_shed.labels(reason="backpressure").inc()
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        _sync_arena_metrics(arena, metrics)
         if fr is not None:
             fr.edge("decode", time.perf_counter() - t0)
         if parsed is None:  # codec unavailable or malformed bytes
@@ -174,7 +230,7 @@ async def _raw_columns_edge(raw, context, gate_ok, tick, msg_type,
         cols, errors, special = parsed
         if not special and not errors:
             try:
-                mat, errs = await tick(cols)
+                mat, errs = await tick(cols, deadline=deadline)
             except BatchTooLargeError as e:
                 cols.release()  # rejected before the tick loop saw it
                 await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
@@ -205,13 +261,19 @@ class V1Servicer:
     def __init__(self, instance: V1Instance):
         self.instance = instance
 
+    def _default_budget(self) -> float:
+        return self.instance.tick_loop.admission.request_timeout
+
     async def GetRateLimits(self, raw: bytes, context):
+        deadline = _edge_deadline(context, self._default_budget())
         fast, msg = await _raw_columns_edge(
             raw, context,
             self.instance.columns_fast_path_ok(),
             self.instance.get_rate_limits_columns,
             pb.GetRateLimitsReq,
             arena=self.instance.ingest_arena,
+            deadline=deadline,
+            metrics=self.instance.metrics,
         )
         if fast is not None:
             if isinstance(fast, bytes):
@@ -219,10 +281,11 @@ class V1Servicer:
             return pb.GetRateLimitsResp(responses=fast)
         if msg is None:
             msg = await _parse_pb(pb.GetRateLimitsReq, raw, context)
+        reqs = convert.reqs_from_pb(msg.requests)
+        for r in reqs:
+            r.deadline = deadline
         try:
-            out = await self.instance.get_rate_limits(
-                convert.reqs_from_pb(msg.requests)
-            )
+            out = await self.instance.get_rate_limits(reqs)
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         return pb.GetRateLimitsResp(responses=convert.resps_to_pb(out))
@@ -247,13 +310,19 @@ class PeersServicer:
     def __init__(self, instance: V1Instance):
         self.instance = instance
 
+    def _default_budget(self) -> float:
+        return self.instance.tick_loop.admission.request_timeout
+
     async def GetPeerRateLimits(self, raw: bytes, context):
+        deadline = _edge_deadline(context, self._default_budget())
         fast, msg = await _raw_columns_edge(
             raw, context,
             self.instance.peer_columns_fast_path_ok(),
             self.instance.get_peer_rate_limits_columns,
             peers_pb.GetPeerRateLimitsReq,
             arena=self.instance.ingest_arena,
+            deadline=deadline,
+            metrics=self.instance.metrics,
         )
         if fast is not None:
             if isinstance(fast, bytes):
@@ -263,10 +332,11 @@ class PeersServicer:
             return peers_pb.GetPeerRateLimitsResp(rate_limits=fast)
         if msg is None:
             msg = await _parse_pb(peers_pb.GetPeerRateLimitsReq, raw, context)
+        reqs = convert.reqs_from_pb(msg.requests)
+        for r in reqs:
+            r.deadline = deadline
         try:
-            out = await self.instance.get_peer_rate_limits(
-                convert.reqs_from_pb(msg.requests)
-            )
+            out = await self.instance.get_peer_rate_limits(reqs)
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         return peers_pb.GetPeerRateLimitsResp(rate_limits=convert.resps_to_pb(out))
@@ -865,16 +935,23 @@ class DaemonClient:
             response_deserializer=lambda b: b,
         )
 
-    async def get_rate_limits(self, reqs, timeout: float = 5.0):
+    async def get_rate_limits(self, reqs, timeout: float = 5.0,
+                              budget_ms: int = None):
+        """``budget_ms`` (optional) rides the ``guber-deadline-ms``
+        metadata key so the server's admission plane sheds work this
+        caller will no longer wait for (docs/overload.md)."""
         msg = pb.GetRateLimitsReq(requests=[convert.req_to_pb(r) for r in reqs])
         hdrs: dict = {}
         tracing.inject(hdrs)
+        if budget_ms is not None:
+            hdrs[DEADLINE_METADATA_KEY] = str(max(0, int(budget_ms)))
         out = await self.stub.GetRateLimits(
             msg, timeout=timeout, metadata=tuple(hdrs.items()) or None
         )
         return [convert.resp_from_pb(r) for r in out.responses]
 
-    async def get_rate_limits_columns(self, cols, timeout: float = 5.0):
+    async def get_rate_limits_columns(self, cols, timeout: float = 5.0,
+                                      budget_ms: int = None):
         """Columnar client fast path: a :class:`ReqColumns` batch (with
         ``name_len``) → native wire encode → raw gRPC → native wire
         decode → ((4, n) status/limit/remaining/reset_time matrix,
@@ -890,6 +967,8 @@ class DaemonClient:
             )
         hdrs: dict = {}
         tracing.inject(hdrs)
+        if budget_ms is not None:
+            hdrs[DEADLINE_METADATA_KEY] = str(max(0, int(budget_ms)))
         out = await self._raw_get_rate_limits(
             raw, timeout=timeout, metadata=tuple(hdrs.items()) or None
         )

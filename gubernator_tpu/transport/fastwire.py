@@ -29,6 +29,7 @@ from gubernator_tpu import native as native_mod
 from gubernator_tpu.ops.reqcols import (
     CREATED_UNSET,
     ColumnArena,
+    IngestOverloadError,
     ReqColumns,
 )
 from gubernator_tpu.types import Behavior
@@ -127,6 +128,14 @@ def parse_req(
         blob = lease.blob
         flags_full = lease.flags
     else:
+        # Bounded fallback (docs/overload.md): a size miss (batch wider
+        # than any slab) always plain-allocates, but busy-slab
+        # exhaustion spends the arena's per-window fallback budget —
+        # past it, the edge sheds instead of growing the heap.
+        if (arena is not None and arena.fits(n, blob_cap)
+                and not arena.try_fallback()):
+            raise IngestOverloadError(
+                "ingest arena exhausted and fallback budget spent")
         blob = np.empty(blob_cap, np.uint8)
         # One zeroed block for all int64 outputs (native writes only the
         # fields present on the wire; proto3 absents must read 0): a

@@ -65,6 +65,10 @@ class RateLimitRequest:
     burst: int = 0
     metadata: Dict[str, str] = field(default_factory=dict)
     created_at: Optional[int] = None  # epoch ms; stamped by server when None
+    # Absolute local-monotonic admission deadline (seconds), stamped at
+    # the serving edge (docs/overload.md).  Never serialized: the wire
+    # carries the relative budget via guber-deadline-ms metadata.
+    deadline: Optional[float] = None
 
     def hash_key(self) -> str:
         """The cluster-sharding key: ``name_uniquekey`` (reference client.go:39-41)."""

@@ -549,6 +549,45 @@ class Metrics:
             registry=reg,
         )
 
+        # Overload control plane (docs/overload.md): bounded-ingest
+        # fallback accounting, shed verdicts by reason, and the adaptive
+        # limiter's admitted window width / queue occupancy.
+        self.arena_fallbacks = Counter(
+            "gubernator_tpu_arena_fallbacks",
+            "Wire-decode batches served from plain numpy allocations "
+            "because every arena slab was busy; capped per window by "
+            "GUBER_INGEST_FALLBACK_LIMIT, shed beyond the cap.",
+            registry=reg,
+        )
+        self.admission_shed = Counter(
+            "gubernator_tpu_admission_shed",
+            "Requests shed by the admission plane, by reason: expired "
+            "(deadline passed before packing), overflow (bounded queue "
+            "full), shutdown (drained at close), backpressure (ingest "
+            "arena exhausted past the fallback cap).",
+            ["reason"],
+            registry=reg,
+        )
+        self.admission_queue_depth = Gauge(
+            "gubernator_tpu_admission_queue_depth",
+            "Requests waiting in the bounded two-class admission queue "
+            "(peer reconcile traffic + client traffic).",
+            registry=reg,
+        )
+        self.admission_window_limit = Gauge(
+            "gubernator_tpu_admission_window_limit",
+            "Current AIMD-admitted window width in requests (static "
+            "batch_limit when GUBER_TARGET_P99_MS is 0).",
+            registry=reg,
+        )
+        self.admission_expired_served = Counter(
+            "gubernator_tpu_admission_expired_served",
+            "Invariant violations: requests whose deadline had already "
+            "expired at pack time but that reached the engine anyway. "
+            "Must stay 0; gated by the overload_shed bench rung.",
+            registry=reg,
+        )
+
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
         (reference flags.go:20-23 + daemon.go:276-287).  "os" → process

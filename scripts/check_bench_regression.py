@@ -52,6 +52,11 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   mesh_dropped_keys /            0   — every decision issued to the
 #   mesh_double_served                   sharded table resolves exactly
 #                                        once (issued == hits+misses)
+#   expired_served                 0   — the overload rung's requests
+#                                        whose deadline passed before
+#                                        packing must be shed, never
+#                                        served real answers
+#                                        (docs/overload.md)
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -63,6 +68,7 @@ COUNT_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "expired_served",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -85,9 +91,16 @@ COUNT_KEYS = (
 #                           is generous because the ratio of two noisy
 #                           rates flaps, but the ABSOLUTE_MAX_KEYS cap
 #                           below holds it at 1.05 regardless
+#   overload_admitted_p99_ms  p99 of requests ADMITTED while the
+#                           overload rung offers ~10x sustainable load —
+#                           lower is better, 1.5x slack (same tail-noise
+#                           argument as loopback_p99_ms); a collapse
+#                           here means the bounded queue stopped
+#                           bounding queueing delay (docs/overload.md)
 LOWER_BETTER_SLACK = {
     "serve_cpu_ms_per_batch": 1.3,
     "loopback_p99_ms": 1.5,
+    "overload_admitted_p99_ms": 1.5,
     "stage_decode_p99_ms": 1.5,
     "stage_pack_p99_ms": 1.5,
     "stage_h2d_p99_ms": 1.5,
@@ -104,9 +117,15 @@ LOWER_BETTER_SLACK = {
 #                           near-linear-scaling observable of the
 #                           sharded serving table; HIGHER is better,
 #                           candidate must keep >= 0.9x the baseline
+#   overload_goodput_ratio  decisions served within budget under ~10x
+#                           load / the same instance's unloaded rate —
+#                           HIGHER is better (shed answers are cheap;
+#                           goodput must survive saturation), candidate
+#                           keeps >= 0.9x the baseline's ratio
 HIGHER_BETTER_FLOOR = {
     "h2d_overlap_ratio": 0.9,
     "mesh_scaling_efficiency": 0.9,
+    "overload_goodput_ratio": 0.9,
 }
 # ...and, baseline or not, a pipelined dispatch that stops overlapping
 # at all is a regression in its own right: absolute floor on the
@@ -114,6 +133,10 @@ HIGHER_BETTER_FLOOR = {
 # sits near 1.0; 0.5 is the alarm threshold, not the target).
 ABSOLUTE_MIN_KEYS = {
     "h2d_overlap_ratio": 0.5,
+    # Overload protection that degrades past this is a failed build no
+    # matter what the baseline measured: under ~10x offered load the
+    # instance must keep serving >= 0.7x its own unloaded rate.
+    "overload_goodput_ratio": 0.7,
 }
 # Absolute ceilings on the candidate, the MIN keys' mirror: telemetry
 # must stay effectively free (≤5% serving-rate cost with the flight
@@ -121,12 +144,20 @@ ABSOLUTE_MIN_KEYS = {
 # that already regressed must not grant the candidate a free pass.
 ABSOLUTE_MAX_KEYS = {
     "telemetry_overhead_ratio": 1.05,
+    # A saturated daemon sheds the excess; it must not buffer it into
+    # RSS.  The overload phase may not grow peak RSS past this bound.
+    "overload_rss_growth_mb": 2048,
 }
 
 GATED_VALUE_KEYS = (
     COUNT_KEYS + tuple(LOWER_BETTER_SLACK) + tuple(HIGHER_BETTER_FLOOR)
     + tuple(ABSOLUTE_MAX_KEYS)
 )
+
+# Keys gated ONLY by their absolute bound above, never baseline-relative:
+# a 1 MB -> 3 MB RSS wiggle is allocator noise, not a 3x regression, so
+# a relative comparison on a near-zero base would flap forever.
+ABSOLUTE_ONLY_KEYS = ("overload_rss_growth_mb",)
 
 # Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
 # the rung: each is an absolute correctness invariant, not a relative
@@ -139,6 +170,7 @@ ABSOLUTE_ZERO_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "expired_served",
 )
 
 
@@ -310,6 +342,8 @@ def main():
               f"({1 / slowdown:.2f}x, allowed {1 / allowed:.2f}x, {mark})")
     base_counts, cand_counts = counts(base_doc), counts(cand_doc)
     for key in sorted(set(base_counts) & set(cand_counts)):
+        if key[1] in ABSOLUTE_ONLY_KEYS:
+            continue  # gated by its absolute bound below, never relatively
         b, c = base_counts[key], cand_counts[key]
         name = f"{key[0]}.{key[1]}"
         gated += 1
@@ -363,6 +397,8 @@ def main():
             print(f"  {key[0]}.{key[1]}: {v:g} "
                   f"(absolute invariant, must be 0, {mark})")
             continue
+        if key in cand_counts and key[1] in ABSOLUTE_ONLY_KEYS:
+            continue  # already judged against its absolute bound above
         side = "candidate" if key not in base_counts else "baseline"
         print(f"  {key[0]}.{key[1]}: only in {side} — not gated")
     if gated == 0 and not args.allow_empty:

@@ -405,3 +405,42 @@ async def test_chaos_forward_path_faults_surface_as_retries():
         assert_no_loop_dead(c)
     finally:
         await c.stop()
+
+
+async def test_chaos_overload_spent_budget_sheds_not_hangs(tmp_path):
+    """Overload scenario (docs/overload.md): a caller whose propagated
+    budget is already spent gets an immediate retriable shed answer —
+    the daemon never queues or serves work nobody is waiting for — and
+    healthy traffic through the same daemon is untouched."""
+    from gubernator_tpu.admission import SHED_EXPIRED_MSG
+
+    conf = DaemonConfig(
+        grpc_listen_address="127.0.0.1:0",
+        http_listen_address="",
+        peer_discovery_type="none",
+    )
+    conf.config = Config(cache_size=1024)
+    d = Daemon(conf)
+    await d.start()
+    await d.wait_for_connect()
+    try:
+        client = d.client()
+        # Zero remaining budget rides guber-deadline-ms: expired on
+        # arrival, shed before the device ever sees it.
+        out = await client.get_rate_limits(
+            [_local_req("ov-dead", hits=1)], budget_ms=0)
+        assert out[0].error == SHED_EXPIRED_MSG
+        shed = d.instance.tick_loop.metric_shed_admission
+        assert shed.get("expired", 0) >= 1
+        assert d.instance.tick_loop.metric_expired_served == 0
+
+        # A generous budget and a budget-less request both serve.
+        out = await client.get_rate_limits(
+            [_local_req("ov-live", hits=1)], budget_ms=30_000)
+        assert out[0].error == "" and out[0].status == Status.UNDER_LIMIT
+        out = await client.get_rate_limits([_local_req("ov-live", hits=1)])
+        assert out[0].error == ""
+        assert 1_000 - out[0].remaining == 2  # shed never consumed hits
+        await client.close()
+    finally:
+        await d.close()

@@ -504,9 +504,14 @@ def test_repo_hot_path_markers_present():
             "_resolve_columns_locked", "_account_misses",
             "_dispatch_routed", "_dispatch_blocked"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
+        # Overload control plane (docs/overload.md): queue admission,
+        # window pops, and limiter feedback all run per serving window.
+        "gubernator_tpu/admission/queue.py": ["push", "pop_window"],
+        "gubernator_tpu/admission/limiter.py": ["record"],
         # Zero-copy ingest edge: the wire decode/encode and the arena
-        # lease run once per serving window too.
-        "gubernator_tpu/ops/reqcols.py": ["lease"],
+        # lease (plus its bounded-fallback accounting) run once per
+        # serving window too.
+        "gubernator_tpu/ops/reqcols.py": ["lease", "try_fallback"],
         "gubernator_tpu/transport/fastwire.py": ["parse_req",
                                                  "encode_resp"],
         # Telemetry plane (docs/observability.md): the flight recorder's
