@@ -1899,6 +1899,145 @@ def rung_overload():
 
 
 # ----------------------------------------------------------------------
+# Cooperative quota-lease rung (docs/leases.md)
+# ----------------------------------------------------------------------
+def rung_engine_leases():
+    """Client-side cooperative leases vs per-request server decisions.
+
+    Phase 1 (baseline) serves every admission as an ordinary engine
+    decision: server-served items == client admissions.  Phase 2 serves
+    the same admission stream through a LeaseCache backed by
+    LeaseManager.grant_local/sync_local — the server sees only the lease
+    *edges* (grants, delta syncs, the shutdown release round), an order
+    of magnitude fewer served items at identical bucket accounting.
+
+    Exported gates (scripts/check_bench_regression.py):
+
+      lease_traffic_reduction    baseline served items / lease-mode
+                                 served items — HIGHER is better, with
+                                 an absolute >=10x floor (the headline)
+      lease_over_admission       sum over keys of max(0, local
+                                 admissions - granted budget): the
+                                 never-over-admit invariant
+                                 (ABSOLUTE_ZERO)
+      lease_dispatch_per_window  device dispatches per lease column
+                                 window — batched on-device accounting
+                                 means exactly one (absolute max 1.0)
+      lease_bucket_drift         max over keys of |bucket remaining -
+                                 (limit - admissions)| after the release
+                                 round settles: the constant-decision-
+                                 correctness observable (ABSOLUTE_ZERO)
+    """
+    from gubernator_tpu.leases import (
+        LeaseCache, LeaseConfig, LeaseManager, LeaseSigner, LeaseSpec)
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.types import RateLimitRequest
+
+    n_keys = 64 if FAST else 512
+    per_key = 50 if FAST else 200
+    limit, duration = 1_000_000, 3_600_000
+    now = [1_700_000_000_000]  # virtual ms; both tiers see this clock
+
+    eng = TickEngine(capacity=1 << 12, max_batch=max(64, n_keys))
+
+    def reqs(prefix, hits=1):
+        return [RateLimitRequest(
+            name="lease_bench", unique_key=f"{prefix}{i}", hits=hits,
+            limit=limit, duration=duration, algorithm=0,
+        ) for i in range(n_keys)]
+
+    # -- Phase 1: every admission is a server-served decision ----------
+    eng.process(reqs("warm_"), now=now[0])  # compile the batch width
+    t0 = time.perf_counter()
+    for r in range(per_key):
+        eng.process(reqs("base_"), now=now[0] + r)
+    base_dt = time.perf_counter() - t0
+    base_items = n_keys * per_key
+
+    # -- Phase 2: the same admission stream through the lease tier -----
+    mgr = LeaseManager(
+        eng,
+        config=LeaseConfig(
+            ttl_ms=60_000, max_budget=per_key, secret=b"bench-lease"),
+        signer=LeaseSigner(secret=b"bench-lease"),
+        clock=lambda: now[0] / 1000.0,
+    )
+    served = {"items": 0}
+    granted = {}
+
+    def grant_fn(specs):
+        served["items"] += len(specs)
+        toks = mgr.grant_local(specs, now_ms=now[0])
+        for s, t in zip(specs, toks):
+            if t is not None:
+                granted[s.key] = granted.get(s.key, 0) + t.budget
+        return toks
+
+    def sync_fn(syncs):
+        served["items"] += len(syncs)
+        return mgr.sync_local(syncs, now_ms=now[0])
+
+    cache = LeaseCache(
+        grant_fn, sync_fn, clock=lambda: now[0] / 1000.0,
+        verifier=mgr.verifier(), want_budget=per_key,
+    )
+    specs = [LeaseSpec(name="lease_bench", key=f"lease_{i}", limit=limit,
+                       duration=duration) for i in range(n_keys)]
+    # Warm the 1-wide grant/sync/column programs outside the timing.
+    cache.admit(LeaseSpec(name="lease_bench", key="lease_warm",
+                          limit=limit, duration=duration))
+    served["items"] = 0
+    granted.clear()
+    disp0, win0 = eng.metric_lease_dispatches, eng.metric_lease_windows
+
+    admits = {s.key: 0 for s in specs}
+    t0 = time.perf_counter()
+    for r in range(per_key):
+        now[0] += 1
+        for s in specs:
+            if cache.admit(s):
+                admits[s.key] += 1
+    lost = cache.close()  # release round: one batched sync window
+    lease_dt = time.perf_counter() - t0
+    lease_items = served["items"]
+
+    over = sum(
+        max(0, admits[s.key] - granted.get(s.key, 0)) for s in specs)
+    disp = eng.metric_lease_dispatches - disp0
+    wins = eng.metric_lease_windows - win0
+
+    # Constant correctness: after the release round settles, every lease
+    # bucket holds exactly limit - per_key — the same accounting a
+    # per-request phase leaves behind (hits=0 probes consume nothing).
+    probe = eng.process(
+        [RateLimitRequest(
+            name="lease_bench", unique_key=s.key, hits=0, limit=limit,
+            duration=duration, algorithm=0) for s in specs],
+        now=now[0])
+    drift = max(abs((limit - per_key) - r.remaining) for r in probe)
+
+    return {
+        "rung": "engine_leases",
+        "keys": n_keys,
+        "admissions_per_key": per_key,
+        "measured": True,
+        "baseline_served_items": base_items,
+        "lease_served_items": lease_items,
+        "baseline_served_rps": round(base_items / max(base_dt, 1e-9), 1),
+        "lease_served_rps": round(lease_items / max(lease_dt, 1e-9), 1),
+        "lease_traffic_reduction": round(
+            base_items / max(1, lease_items), 2),
+        "lease_over_admission": int(over),
+        "lease_dispatch_per_window": round(disp / max(1, wins), 4),
+        "lease_bucket_drift": int(drift),
+        "lease_sync_lost": int(lost),
+        "local_admits": cache.metric_local_admits,
+        "grants": mgr.metric_grants,
+        "backend": jax.default_backend(),
+    }
+
+
+# ----------------------------------------------------------------------
 # Sharded-table mesh rung (8 virtual devices, CPU backend, subprocess)
 # ----------------------------------------------------------------------
 def child_mesh_tick():
@@ -2537,6 +2676,9 @@ def main():
     # Right after the service rung: the overload rung reuses its
     # already-compiled narrow serving program at the same capacity.
     ladder.append(_safe("overload_shed", rung_overload))
+    # Lease tier headline: server-served traffic drops >=10x while the
+    # bucket accounting stays exact (docs/leases.md).
+    ladder.append(_safe("engine_leases", rung_engine_leases))
     ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))

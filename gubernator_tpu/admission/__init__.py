@@ -111,3 +111,21 @@ class AdmissionConfig:
         if self.pending_limit > 0:
             return self.pending_limit
         return max(1, 8 * int(window_limit))
+
+
+def under_pressure(
+    limiter: AimdLimiter,
+    pending: int,
+    pending_limit: int,
+    batch_limit: int,
+) -> bool:
+    """Overload-degrade trigger for the lease tier (docs/leases.md):
+    True when the AIMD limiter has backed off below the full window, or
+    the pending queue has filled past half its bound.  Under pressure,
+    lease grants degrade to cheap TTL extension of already-held budget
+    (no decision, no device work) instead of full decisions — the lease
+    analog of docs/overload.md's shed-before-pack discipline."""
+    if limiter is not None and limiter.enabled:
+        if limiter.window_limit < int(batch_limit):
+            return True
+    return pending >= max(1, int(pending_limit)) // 2

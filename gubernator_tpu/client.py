@@ -91,3 +91,91 @@ def dial_v1(server: str, tls=None):
             else tls.channel_credentials()
         )
     return DaemonClient(server, credentials=creds)
+
+
+class LeaseSession:
+    """Async driver over :class:`~gubernator_tpu.leases.LeaseCache`
+    against a dialed daemon (docs/leases.md).
+
+    While a signed lease holds budget, :meth:`admit` answers locally —
+    zero server round trips; at the lease edges (grant, exhaustion,
+    expiry) it runs one sync+grant round over the client's lease RPCs.
+    ``admit`` returning None means the lease tier has no answer (server
+    declined to delegate, or budget cap below the hits batch): fall back
+    to ``client.get_rate_limits`` for an ordinary server decision.
+
+    ``close()`` flushes unsynced consumption through the normal sync
+    path, bounded and deadline-capped — see :meth:`LeaseCache.close`.
+    """
+
+    def __init__(self, client, *, verifier=None, want_budget: int = 0,
+                 offline_grace_ms: int = 5_000,
+                 max_offline_extensions: int = 3, clock=time.time):
+        from gubernator_tpu.leases import LeaseCache
+
+        self.client = client
+        self.cache = LeaseCache(
+            clock=clock, verifier=verifier, want_budget=want_budget,
+            offline_grace_ms=offline_grace_ms,
+            max_offline_extensions=max_offline_extensions,
+        )
+
+    async def admit(self, spec, hits: int = 1):
+        """True/False = local lease verdict; None = no lease path, make
+        an ordinary server request."""
+        from gubernator_tpu.leases.cache import ADMIT, NEED_LEASE
+
+        c = self.cache
+        verdict = c.try_admit(spec, hits)
+        if verdict == ADMIT:
+            return True
+        if verdict != NEED_LEASE:
+            c.metric_local_denies += hits
+            return False
+        # One sync+grant round, then one retry (the cache's convenience
+        # driver, inlined with awaits; RPC failure → bounded offline
+        # extension instead of failing the caller).
+        try:
+            syncs = c.take_syncs()
+            if syncs:
+                c.note_synced(syncs, await self.client.lease_sync(syncs))
+            tokens = await self.client.lease_grant([c.fill_want(spec)])
+        except Exception:
+            if c.extend_offline(spec):
+                if c.try_admit(spec, hits) == ADMIT:
+                    return True
+                c.metric_local_denies += hits
+                return False
+            return None
+        if not c.note_grant(spec, tokens[0] if tokens else None):
+            return None
+        verdict = c.try_admit(spec, hits)
+        if verdict == ADMIT:
+            return True
+        if verdict == NEED_LEASE:
+            return None
+        c.metric_local_denies += hits
+        return False
+
+    async def close(self, deadline: float = None, attempts: int = 2) -> int:
+        """Drain unsynced consumption through the server sync path;
+        returns admissions left unsynced (also counted in the cache's
+        ``metric_sync_lost``)."""
+        c = self.cache
+        if not c.mark_closed():
+            return 0
+        for _ in range(max(1, attempts)):
+            if deadline is not None and c.now_ms() >= deadline * 1000:
+                break
+            syncs = c.take_syncs(release=True)
+            if not syncs:
+                break
+            try:
+                acks = await self.client.lease_sync(syncs)
+            except Exception:
+                continue
+            c.note_synced(syncs, acks)
+        return c.abandon_unsynced()
+
+    def stats(self):
+        return self.cache.stats()

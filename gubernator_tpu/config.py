@@ -92,6 +92,13 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_K8S_POD_IP": "k8s discovery: this pod's IP",
     "GUBER_K8S_POD_PORT": "k8s discovery: this pod's port",
     "GUBER_K8S_WATCH_MECHANISM": "k8s discovery: 'endpoints' or 'pods'",
+    "GUBER_LEASE_BUDGET_FRACTION": "limit fraction delegated per lease grant",
+    "GUBER_LEASE_CREDIT_BACK": "credit unused lease budget back on release (0/1)",
+    "GUBER_LEASE_ENABLED": "cooperative quota-lease tier on/off",
+    "GUBER_LEASE_MAX_BUDGET": "hard cap on admissions per lease grant",
+    "GUBER_LEASE_OFFLINE_GRACE": "client lease extension window when owner unreachable",
+    "GUBER_LEASE_SECRET": "shared HMAC lease-signing secret ('' = per-process)",
+    "GUBER_LEASE_TTL": "lease validity window (duration)",
     "GUBER_LOG_FORMAT": "log format: text or json",
     "GUBER_LOG_LEVEL": "log level: debug/info/warning/error",
     "GUBER_MEMBERLIST_ADDRESS": "member-list discovery: bind address",

@@ -1505,6 +1505,17 @@ SNAP_FIELDS = (
 )
 
 
+# Cooperative quota-lease columns (docs/leases.md), parallel to the SoA
+# table and exported as EXTRA snapshot keys (np.savez carries them
+# transparently) so outstanding delegations survive a restore.  Kept out
+# of SNAP_FIELDS proper: the slim-transfer probe/select schema, the item
+# dict shape, and the cold tier's column contract all iterate
+# SNAP_FIELDS, and a pre-lease snapshot must keep loading (absent keys
+# restore as all-zeros = no outstanding delegation, which is the safe
+# reading: clients re-grant).
+LEASE_SNAP_FIELDS = ("lease_budget", "lease_expire", "lease_gen")
+
+
 # Wide (int64) snapshot fields, in SNAP_FIELDS order, minus the narrow
 # algorithm/status columns — the unit of the slim-transfer schema below.
 SNAP_WIDE = (
@@ -1747,6 +1758,31 @@ def _jitted_restore(layout: str = "columns"):
 @functools.lru_cache(maxsize=None)
 def _jitted_readback(layout: str = "columns"):
     return jax.jit(make_readback_fn(layout))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_lease_apply(is_set: bool):
+    """One lease-column window as a single scatter over the three lease
+    columns (docs/leases.md).  ``is_set`` picks grant semantics (install
+    the authoritative outstanding/expiry/generation triple) vs reconcile
+    deltas (budget += delta clamped at zero; expiry/generation only move
+    forward).  Padding lanes carry slot == capacity, which ``mode="drop"``
+    discards on device — no host-side masking pass."""
+
+    def f(budget_col, expire_col, gen_col, slots, budgets, expires, gens):
+        if is_set:
+            budget_col = budget_col.at[slots].set(budgets, mode="drop")
+            expire_col = expire_col.at[slots].set(expires, mode="drop")
+            gen_col = gen_col.at[slots].set(gens, mode="drop")
+        else:
+            budget_col = jnp.maximum(
+                budget_col.at[slots].add(budgets, mode="drop"), 0
+            )
+            expire_col = expire_col.at[slots].max(expires, mode="drop")
+            gen_col = gen_col.at[slots].max(gens, mode="drop")
+        return budget_col, expire_col, gen_col
+
+    return jax.jit(f, donate_argnums=(0, 1, 2))
 
 
 class SlotMap:
@@ -2273,6 +2309,20 @@ class TickEngine:
         self.metric_demote_readbacks = 0
         self.metric_evict_reclaims = 0
         self.metric_shed_requests = 0
+        # Cooperative quota-lease columns (docs/leases.md): per-slot
+        # outstanding delegated budget, lease expiry (epoch ms), and
+        # generation — device-resident so grant/renew/reconcile land as
+        # ONE batched scatter per window (lease_window; the exact-work
+        # dispatch counter below proves one dispatch per window) and so
+        # delegations survive a snapshot round-trip (LEASE_SNAP_FIELDS).
+        # Nomenclature: StagingRing "leases" are H2D slab reservations;
+        # everything lease_* on the engine is quota leases.
+        self._lease_budget = jnp.zeros(self.capacity, jnp.int64)
+        self._lease_expire = jnp.zeros(self.capacity, jnp.int64)
+        self._lease_gen = jnp.zeros(self.capacity, jnp.int32)
+        self.metric_lease_dispatches = 0
+        self.metric_lease_windows = 0
+        self.metric_lease_ops = 0
         self._warmup()
 
     def _warmup(self) -> None:
@@ -3136,6 +3186,84 @@ class TickEngine:
     # ------------------------------------------------------------------
     # Snapshot / restore (Loader.Load/Save analog, workers.go:329-534)
     # ------------------------------------------------------------------
+    @hot_path
+    def lease_window(
+        self,
+        keys: Sequence[bytes],
+        budgets: Sequence[int],
+        expires: Sequence[int],
+        gens: Sequence[int],
+        is_set: bool = True,
+    ) -> int:
+        """Apply one window of quota-lease column mutations as ONE
+        batched device scatter (docs/leases.md).
+
+        ``is_set=True`` installs authoritative (outstanding, expiry,
+        generation) triples — the grant/sync commit path; ``False``
+        applies reconcile deltas (budget += delta clamped ≥ 0,
+        expiry/generation monotone).  Keys not resident in the hot table
+        are skipped — the LeaseManager's host records stay authoritative
+        and re-mirror on the next window that finds the slot.  Returns
+        the number of column updates applied; exactly one device
+        dispatch regardless (metric_lease_dispatches/windows is the
+        exact-work invariant the lease tests pin at 1.0)."""
+        n = len(keys)
+        if n == 0:
+            return 0
+        with self._lock:
+            get = self.slots.get
+            slots = np.full(n, self.capacity, np.int64)
+            for j in range(n):
+                s = get(keys[j].decode())
+                if s is not None:
+                    slots[j] = s
+            live = slots < self.capacity
+            w = pad_pow2(n)
+            slot_pad = np.full(w, self.capacity, np.int64)
+            slot_pad[:n] = slots
+            bud = np.zeros(w, np.int64)
+            bud[:n] = budgets
+            exp = np.zeros(w, np.int64)
+            exp[:n] = expires
+            gen = np.zeros(w, np.int32)
+            gen[:n] = gens
+            fn = _jitted_lease_apply(is_set)
+            self._lease_budget, self._lease_expire, self._lease_gen = fn(
+                self._lease_budget, self._lease_expire, self._lease_gen,
+                jnp.asarray(slot_pad), jnp.asarray(bud), jnp.asarray(exp),
+                jnp.asarray(gen),
+            )
+            self.metric_lease_dispatches += 1
+            self.metric_lease_windows += 1
+            applied = int(live.sum())
+            self.metric_lease_ops += applied
+            self._dirty[slots[live]] = True
+            return applied
+
+    def lease_columns(self, keys: Sequence[bytes]):
+        """Host readback of the lease columns for a batch of keys:
+        (budget, expire_ms, generation) int64/int64/int32 arrays, zeros
+        for non-resident keys.  Diagnostics/tests only — the serving
+        path never reads these back."""
+        n = len(keys)
+        with self._lock:
+            get = self.slots.get
+            slots = np.full(n, -1, np.int64)
+            for j in range(n):
+                s = get(keys[j].decode())
+                if s is not None:
+                    slots[j] = s
+            live = slots >= 0
+            bud = np.zeros(n, np.int64)
+            exp = np.zeros(n, np.int64)
+            gen = np.zeros(n, np.int32)
+            if live.any():
+                idx = jnp.asarray(slots[live])
+                bud[live] = np.asarray(self._lease_budget[idx])
+                exp[live] = np.asarray(self._lease_expire[idx])
+                gen[live] = np.asarray(self._lease_gen[idx])
+            return bud, exp, gen
+
     def export_columns(self, dirty_only: bool = False) -> dict:
         """Bulk snapshot: numpy columns + one key blob (the Loader v2
         format; see SNAP_FIELDS).  The reference streams items through a
@@ -3184,6 +3312,7 @@ class TickEngine:
                     )
                     for f in SNAP_FIELDS
                 },
+                **{f: np.zeros(0, np.int64) for f in LEASE_SNAP_FIELDS},
             }
             if n == 0:
                 self.last_export_stats = {
@@ -3232,6 +3361,16 @@ class TickEngine:
             snap: dict = {"key_blob": blob, "key_offsets": offsets}
             for name in SNAP_FIELDS:
                 snap[name] = np.concatenate([c[name] for c in chunks])
+            # Lease columns ride as extra snapshot keys gathered at the
+            # same live slots (order-aligned with the key blob).  One
+            # device gather per column per export, not per chunk: the
+            # lease columns are narrow (24 B/slot total), so the slim
+            # probe/select machinery isn't worth threading them through.
+            lidx = jnp.asarray(live)
+            snap["lease_budget"] = np.array(self._lease_budget[lidx])
+            snap["lease_expire"] = np.array(self._lease_expire[lidx])
+            snap["lease_gen"] = np.array(
+                self._lease_gen[lidx], dtype=np.int64)
             self.last_export_stats = {
                 "d2h_bytes": d2h,
                 "items": len(live),
@@ -3260,6 +3399,15 @@ class TickEngine:
         snap["key_offsets"] = np.concatenate([off1, offs2[1:] + base])
         for f in SNAP_FIELDS:
             snap[f] = np.concatenate([np.asarray(snap[f]), ccols[f]])
+        for f in LEASE_SNAP_FIELDS:
+            # Cold rows hold no delegation (demotion targets idle slots;
+            # leases live on hot, recently-granted keys): zero-pad so the
+            # lease columns stay aligned with the merged key blob.
+            if f in snap:
+                snap[f] = np.concatenate([
+                    np.asarray(snap[f]),
+                    np.zeros(len(ckeys), np.int64),
+                ])
         self.last_export_stats["items"] = (
             self.last_export_stats.get("items", 0) + len(ckeys)
         )
@@ -3288,6 +3436,12 @@ class TickEngine:
             if n == 0:
                 return
             cols = {f: np.asarray(snap[f]) for f in SNAP_FIELDS}
+            # Pre-lease snapshots simply lack the lease keys: restore
+            # them as no-delegation (zeros) rather than failing.
+            has_lease = all(f in snap for f in LEASE_SNAP_FIELDS)
+            if has_lease:
+                for f in LEASE_SNAP_FIELDS:
+                    cols[f] = np.asarray(snap[f])
             blob = snap["key_blob"]
             keep = cols["expire_at"] >= now
             if not keep.all():
@@ -3340,6 +3494,21 @@ class TickEngine:
                 self.state = self._restore(
                     self.state, jnp.asarray(ints), jnp.asarray(floats)
                 )
+            if has_lease:
+                # Restore the lease columns with one host read-modify-
+                # write + push per column: restores are rare (startup,
+                # failover) and the columns are narrow, so clarity beats
+                # a fourth jitted scatter here.
+                tgt = slots[sel]
+                lb = np.array(self._lease_budget)
+                le = np.array(self._lease_expire)
+                lg = np.array(self._lease_gen)
+                lb[tgt] = cols["lease_budget"][sel]
+                le[tgt] = cols["lease_expire"][sel]
+                lg[tgt] = cols["lease_gen"][sel]
+                self._lease_budget = jnp.asarray(lb)
+                self._lease_expire = jnp.asarray(le)
+                self._lease_gen = jnp.asarray(lg)
 
     def load_items(self, items: Sequence[dict], now: Optional[int] = None) -> None:
         """Install snapshot items into the table (the dict-shaped Loader

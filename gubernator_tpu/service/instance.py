@@ -291,6 +291,17 @@ class V1Instance:
         # tracked + done-callback-logged (the doomed-peer pattern) so no
         # forward ever dies silently, and close() can await stragglers.
         self._forward_tasks: set = set()
+        # Cooperative quota leases (docs/leases.md): mints signed
+        # TTL-bounded budget delegations, reconciles consumption as
+        # batched engine work, and degrades to cheap TTL extension when
+        # the tick loop reports pressure.  Always constructed — with
+        # GUBER_LEASE_ENABLED=0 every grant is declined, which clients
+        # read as "no lease tier here".
+        from gubernator_tpu.leases import LeaseManager
+
+        self.lease_mgr = LeaseManager(
+            self.engine, tick_loop=self.tick_loop, metrics=self.metrics,
+        )
         # Crash-safe persistence (docs/persistence.md): wired by create().
         self._snapshot_writer = None
         self.restore_stats: dict = {}
@@ -850,6 +861,23 @@ class V1Instance:
         await asyncio.get_running_loop().run_in_executor(
             None, self.engine.install_globals, list(updates)
         )
+
+    # ------------------------------------------------------------------
+    # Cooperative quota leases (docs/leases.md)
+    # ------------------------------------------------------------------
+    async def lease_grant(self, specs):
+        """Mint quota leases: [LeaseSpec] → [Optional[LeaseToken]].
+        Delegation is an ordinary batched decision through the tick
+        loop (UNDER_LIMIT charges the slice up front; OVER_LIMIT
+        declines with None), so grants ride the same admission plane
+        as everything else."""
+        return await self.lease_mgr.grant(list(specs))
+
+    async def lease_sync(self, syncs):
+        """Reconcile lease consumption: [LeaseSync] → [LeaseSyncAck].
+        Credit-backs and excess force-charges flow through the tick
+        loop in the peer class."""
+        return await self.lease_mgr.sync(list(syncs))
 
     # ------------------------------------------------------------------
     # Health / peers

@@ -315,6 +315,79 @@ class PeerClient:
             raise
         self.breaker.record_success()
 
+    def _lease_raw(self, method: str):
+        """Raw-bytes unary on this peer's channel for a V1 lease method
+        (both services share the peer's port; the frames are the pure-
+        Python codecs in transport/fastwire.py)."""
+        self._ensure_channel()
+        return self._channel.unary_unary(
+            f"/pb.gubernator.V1/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+
+    async def lease_grant(self, specs):
+        """Request quota leases from this peer (the key owner).  Breaker-
+        gated like every peer RPC: an OPEN breaker raises
+        :class:`BreakerOpenError`, which the client's LeaseCache answers
+        by extending its held lease locally (docs/leases.md) — the lease
+        analog of PR 3's degraded-answer path."""
+        from gubernator_tpu.transport import fastwire
+
+        addr = self._info.grpc_address
+        if not self.breaker.allow():
+            msg_ = f"circuit breaker open for peer {addr}"
+            self.last_errs.record(msg_)
+            raise BreakerOpenError(msg_)
+        rpc = self._lease_raw("LeaseGrant")
+        try:
+            if self.faults is not None:
+                await self.faults.before_rpc(addr, "LeaseGrant")
+            out = await rpc(
+                fastwire.encode_lease_grant_req(list(specs)),
+                timeout=self.behaviors.batch_timeout,
+            )
+        except grpc.aio.AioRpcError as e:
+            self.breaker.record_failure()
+            self.last_errs.record(
+                f"while granting leases from peer {addr}: {e.details()}"
+            )
+            raise
+        self.breaker.record_success()
+        tokens = fastwire.parse_lease_grant_resp(out)
+        if tokens is None:
+            raise RuntimeError("malformed LeaseGrant response frame")
+        return tokens
+
+    async def lease_sync(self, syncs):
+        """Report lease consumption to this peer (the key owner)."""
+        from gubernator_tpu.transport import fastwire
+
+        addr = self._info.grpc_address
+        if not self.breaker.allow():
+            msg_ = f"circuit breaker open for peer {addr}"
+            self.last_errs.record(msg_)
+            raise BreakerOpenError(msg_)
+        rpc = self._lease_raw("LeaseSync")
+        try:
+            if self.faults is not None:
+                await self.faults.before_rpc(addr, "LeaseSync")
+            out = await rpc(
+                fastwire.encode_lease_sync_req(list(syncs)),
+                timeout=self.behaviors.batch_timeout,
+            )
+        except grpc.aio.AioRpcError as e:
+            self.breaker.record_failure()
+            self.last_errs.record(
+                f"while syncing leases to peer {addr}: {e.details()}"
+            )
+            raise
+        self.breaker.record_success()
+        acks = fastwire.parse_lease_sync_resp(out)
+        if acks is None:
+            raise RuntimeError("malformed LeaseSync response frame")
+        return acks
+
     def get_last_err(self) -> List[str]:
         return self.last_errs.errors()
 

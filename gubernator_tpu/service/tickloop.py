@@ -36,6 +36,7 @@ from gubernator_tpu.admission import (
     AdmissionQueue,
     AimdLimiter,
     QueueItem,
+    under_pressure,
 )
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse, Status
 from gubernator_tpu.utils import flightrec
@@ -163,6 +164,16 @@ class TickLoop:
         traffic outranks client traffic under overload)."""
         return self._enqueue("obj", list(requests), len(requests),
                              deadline, klass)
+
+    def under_pressure(self) -> bool:
+        """True while the overload plane is actively backing off —
+        the lease tier's cue to answer grants with cheap TTL extension
+        instead of full decisions (admission.under_pressure)."""
+        return under_pressure(
+            self.limiter, self._pending_count,
+            self.admission.effective_pending_limit(self.batch_limit),
+            self.batch_limit,
+        )
 
     def submit_columns(self, cols, deadline: float = None,
                        klass: int = CLASS_CLIENT) -> "Future":

@@ -296,6 +296,27 @@ class V1Servicer:
             status=h.status, message=h.message, peer_count=h.peer_count
         )
 
+    async def LeaseGrant(self, raw: bytes, context):
+        """Quota-lease grant edge (docs/leases.md): raw frame in, raw
+        frame out — lease traffic never touches protobuf."""
+        specs = fastwire.parse_lease_grant_req(raw)
+        if specs is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "malformed LeaseGrant frame")
+        tokens = await self.instance.lease_grant(specs)
+        return fastwire.encode_lease_grant_resp(tokens)
+
+    async def LeaseSync(self, raw: bytes, context):
+        """Quota-lease reconcile edge: consumed counts in, acks out."""
+        syncs = fastwire.parse_lease_sync_req(raw)
+        if syncs is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "malformed LeaseSync frame")
+        acks = await self.instance.lease_sync(syncs)
+        return fastwire.encode_lease_sync_resp(acks)
+
 
 class PeersServicer:
     """pb ↔ dataclass edge for the peer service.
@@ -986,6 +1007,34 @@ class DaemonClient:
 
     async def health_check(self, timeout: float = 5.0):
         return await self.stub.HealthCheck(pb.HealthCheckReq(), timeout=timeout)
+
+    async def lease_grant(self, specs, timeout: float = 5.0):
+        """Request quota leases (docs/leases.md): [LeaseSpec] →
+        [Optional[LeaseToken]] (None = server declined; fall back to
+        per-request decisions)."""
+        hdrs: dict = {}
+        tracing.inject(hdrs)
+        out = await self.stub.LeaseGrant(
+            fastwire.encode_lease_grant_req(specs), timeout=timeout,
+            metadata=tuple(hdrs.items()) or None,
+        )
+        tokens = fastwire.parse_lease_grant_resp(out)
+        if tokens is None:
+            raise RuntimeError("malformed LeaseGrant response frame")
+        return tokens
+
+    async def lease_sync(self, syncs, timeout: float = 5.0):
+        """Report lease consumption: [LeaseSync] → [LeaseSyncAck]."""
+        hdrs: dict = {}
+        tracing.inject(hdrs)
+        out = await self.stub.LeaseSync(
+            fastwire.encode_lease_sync_req(syncs), timeout=timeout,
+            metadata=tuple(hdrs.items()) or None,
+        )
+        acks = fastwire.parse_lease_sync_resp(out)
+        if acks is None:
+            raise RuntimeError("malformed LeaseSync response frame")
+        return acks
 
     async def close(self) -> None:
         await self.channel.close()

@@ -274,3 +274,185 @@ def encode_resp(mat: np.ndarray) -> bytes:
 
             return encode_get_rate_limits_resp(mat)
     return out[:wrote].tobytes()
+
+
+# ----------------------------------------------------------------------
+# Quota-lease frames (docs/leases.md).
+#
+# Lease traffic happens at lease EDGES (grant, expiry, exhaustion,
+# release) — orders of magnitude rarer than decisions — so these frames
+# are pure-Python struct codecs, not native: the codec cost is
+# irrelevant, while the native library must stay optional.  All frames
+# are little-endian with a 4-byte magic + u32 count header; parsers
+# return None on a magic/length mismatch (callers treat that exactly
+# like a malformed protobuf: reject the RPC).
+
+import struct as _struct
+
+_LEASE_GRANT_REQ_MAGIC = b"GLR1"
+_LEASE_GRANT_RESP_MAGIC = b"GLT1"
+_LEASE_SYNC_REQ_MAGIC = b"GSY1"
+_LEASE_SYNC_RESP_MAGIC = b"GSA1"
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return _struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(data: bytes, off: int):
+    (ln,) = _struct.unpack_from("<H", data, off)
+    off += 2
+    return data[off : off + ln].decode(), off + ln
+
+
+def encode_lease_grant_req(specs) -> bytes:
+    """[LeaseSpec] → LeaseGrant request frame."""
+    parts = [_LEASE_GRANT_REQ_MAGIC, _struct.pack("<I", len(specs))]
+    for s in specs:
+        parts.append(_struct.pack(
+            "<qqqqq", s.limit, s.duration, s.algorithm, s.burst, s.want))
+        parts.append(_pack_str(s.name))
+        parts.append(_pack_str(s.key))
+    return b"".join(parts)
+
+
+def parse_lease_grant_req(data: bytes):
+    """LeaseGrant request frame → [LeaseSpec] (None when malformed)."""
+    from gubernator_tpu.leases.protocol import LeaseSpec
+
+    try:
+        if data[:4] != _LEASE_GRANT_REQ_MAGIC:
+            return None
+        (n,) = _struct.unpack_from("<I", data, 4)
+        off = 8
+        out = []
+        for _ in range(n):
+            limit, duration, algo, burst, want = _struct.unpack_from(
+                "<qqqqq", data, off)
+            off += 40
+            name, off = _unpack_str(data, off)
+            key, off = _unpack_str(data, off)
+            out.append(LeaseSpec(
+                name=name, key=key, limit=limit, duration=duration,
+                algorithm=algo, burst=burst, want=want))
+        return out if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None
+
+
+def encode_lease_grant_resp(tokens) -> bytes:
+    """[Optional[LeaseToken]] → LeaseGrant response frame (a None slot
+    is an explicit declined marker: the bucket was too hot to delegate
+    and the client must fall back to per-request decisions)."""
+    parts = [_LEASE_GRANT_RESP_MAGIC, _struct.pack("<I", len(tokens))]
+    for t in tokens:
+        if t is None:
+            parts.append(b"\x00")
+            continue
+        parts.append(b"\x01")
+        parts.append(_struct.pack("<qqq", t.budget, t.expires_ms,
+                                  t.generation))
+        parts.append(_pack_str(t.name))
+        parts.append(_pack_str(t.key))
+        parts.append(_struct.pack("<H", len(t.signature)))
+        parts.append(t.signature)
+    return b"".join(parts)
+
+
+def parse_lease_grant_resp(data: bytes):
+    """LeaseGrant response frame → [Optional[LeaseToken]]."""
+    from gubernator_tpu.leases.protocol import LeaseToken
+
+    try:
+        if data[:4] != _LEASE_GRANT_RESP_MAGIC:
+            return None
+        (n,) = _struct.unpack_from("<I", data, 4)
+        off = 8
+        out = []
+        for _ in range(n):
+            present = data[off]
+            off += 1
+            if not present:
+                out.append(None)
+                continue
+            budget, expires_ms, gen = _struct.unpack_from("<qqq", data, off)
+            off += 24
+            name, off = _unpack_str(data, off)
+            key, off = _unpack_str(data, off)
+            (siglen,) = _struct.unpack_from("<H", data, off)
+            off += 2
+            sig = data[off : off + siglen]
+            off += siglen
+            out.append(LeaseToken(
+                name=name, key=key, budget=budget, expires_ms=expires_ms,
+                generation=gen, signature=sig))
+        return out if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None
+
+
+def encode_lease_sync_req(syncs) -> bytes:
+    """[LeaseSync] → LeaseSync request frame."""
+    parts = [_LEASE_SYNC_REQ_MAGIC, _struct.pack("<I", len(syncs))]
+    for s in syncs:
+        parts.append(_struct.pack(
+            "<qqB", s.consumed, s.generation, 1 if s.release else 0))
+        parts.append(_pack_str(s.name))
+        parts.append(_pack_str(s.key))
+    return b"".join(parts)
+
+
+def parse_lease_sync_req(data: bytes):
+    """LeaseSync request frame → [LeaseSync]."""
+    from gubernator_tpu.leases.protocol import LeaseSync
+
+    try:
+        if data[:4] != _LEASE_SYNC_REQ_MAGIC:
+            return None
+        (n,) = _struct.unpack_from("<I", data, 4)
+        off = 8
+        out = []
+        for _ in range(n):
+            consumed, gen, release = _struct.unpack_from("<qqB", data, off)
+            off += 17
+            name, off = _unpack_str(data, off)
+            key, off = _unpack_str(data, off)
+            out.append(LeaseSync(
+                name=name, key=key, consumed=consumed, generation=gen,
+                release=bool(release)))
+        return out if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None
+
+
+def encode_lease_sync_resp(acks) -> bytes:
+    """[LeaseSyncAck] → LeaseSync response frame."""
+    parts = [_LEASE_SYNC_RESP_MAGIC, _struct.pack("<I", len(acks))]
+    for a in acks:
+        parts.append(_struct.pack(
+            "<Bqqq", 1 if a.accepted else 0, a.generation,
+            a.credited, a.charged))
+    return b"".join(parts)
+
+
+def parse_lease_sync_resp(data: bytes):
+    """LeaseSync response frame → [LeaseSyncAck]."""
+    from gubernator_tpu.leases.protocol import LeaseSyncAck
+
+    try:
+        if data[:4] != _LEASE_SYNC_RESP_MAGIC:
+            return None
+        (n,) = _struct.unpack_from("<I", data, 4)
+        off = 8
+        out = []
+        for _ in range(n):
+            accepted, gen, credited, charged = _struct.unpack_from(
+                "<Bqqq", data, off)
+            off += 25
+            out.append(LeaseSyncAck(
+                accepted=bool(accepted), generation=gen,
+                credited=credited, charged=charged))
+        return out if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None

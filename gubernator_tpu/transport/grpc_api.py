@@ -46,6 +46,23 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
                 request_deserializer=pb.HealthCheckReq.FromString,
                 response_serializer=pb.HealthCheckResp.SerializeToString,
             ),
+            # Quota-lease methods (docs/leases.md): pass-through bytes
+            # both ways — the servicer runs the lease frame codecs
+            # (transport/fastwire.py), no pb messages involved.  Only
+            # registered when the servicer implements leases, so older
+            # daemons keep exporting exactly the reference surface.
+            **({
+                "LeaseGrant": grpc.unary_unary_rpc_method_handler(
+                    servicer.LeaseGrant,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda m: m,
+                ),
+                "LeaseSync": grpc.unary_unary_rpc_method_handler(
+                    servicer.LeaseSync,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda m: m,
+                ),
+            } if hasattr(servicer, "LeaseGrant") else {}),
         },
     )
 
@@ -86,6 +103,17 @@ class V1Stub:
             f"/{V1_SERVICE}/HealthCheck",
             request_serializer=pb.HealthCheckReq.SerializeToString,
             response_deserializer=pb.HealthCheckResp.FromString,
+        )
+        # Raw-bytes lease methods (frame codecs in transport/fastwire.py).
+        self.LeaseGrant = channel.unary_unary(
+            f"/{V1_SERVICE}/LeaseGrant",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self.LeaseSync = channel.unary_unary(
+            f"/{V1_SERVICE}/LeaseSync",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
         )
 
 

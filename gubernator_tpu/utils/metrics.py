@@ -588,6 +588,34 @@ class Metrics:
             registry=reg,
         )
 
+        # Cooperative quota-lease families (docs/leases.md).
+        self.lease_grants = Counter(
+            "gubernator_tpu_lease_grants",
+            "Quota leases minted: budget delegated to a client for "
+            "TTL-bounded local self-enforcement.",
+            registry=reg,
+        )
+        self.lease_renewals = Counter(
+            "gubernator_tpu_lease_renewals",
+            "Cheap lease extensions: held budget re-signed with a "
+            "pushed-out TTL instead of a fresh decision (the overload "
+            "degrade path).",
+            registry=reg,
+        )
+        self.lease_revocations = Counter(
+            "gubernator_tpu_lease_revocations",
+            "Lease generations bumped (limit config changed or explicit "
+            "revoke); outstanding tokens die at their next sync.",
+            registry=reg,
+        )
+        self.lease_sync_loss = Counter(
+            "gubernator_tpu_lease_sync_loss",
+            "Admissions reported by lease syncs beyond the granted "
+            "budget (stale-generation or misbehaving clients); "
+            "force-charged to the bucket on reconcile.",
+            registry=reg,
+        )
+
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
         (reference flags.go:20-23 + daemon.go:276-287).  "os" → process

@@ -57,6 +57,18 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #                                        packing must be shed, never
 #                                        served real answers
 #                                        (docs/overload.md)
+#   lease_over_admission           0   — the lease rung's clients never
+#                                        admit more than their granted
+#                                        budgets (docs/leases.md: the
+#                                        never-over-admit invariant)
+#   lease_bucket_drift             0   — after the lease release round
+#                                        settles, every bucket holds
+#                                        exactly what a per-request
+#                                        phase would leave (constant
+#                                        decision correctness)
+#   lease_dispatch_per_window      1.0 — lease grant/sync accounting is
+#                                        ONE batched column scatter per
+#                                        window, never per-key dispatch
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -69,6 +81,9 @@ COUNT_KEYS = (
     "mesh_dropped_keys",
     "mesh_double_served",
     "expired_served",
+    "lease_over_admission",
+    "lease_bucket_drift",
+    "lease_dispatch_per_window",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -122,10 +137,17 @@ LOWER_BETTER_SLACK = {
 #                           HIGHER is better (shed answers are cheap;
 #                           goodput must survive saturation), candidate
 #                           keeps >= 0.9x the baseline's ratio
+#   lease_traffic_reduction baseline server-served items / lease-mode
+#                           served items on the same admission stream —
+#                           the lease tier's headline (docs/leases.md);
+#                           HIGHER is better, candidate keeps >= 0.9x
+#                           the baseline, and the >=10x absolute floor
+#                           below holds regardless
 HIGHER_BETTER_FLOOR = {
     "h2d_overlap_ratio": 0.9,
     "mesh_scaling_efficiency": 0.9,
     "overload_goodput_ratio": 0.9,
+    "lease_traffic_reduction": 0.9,
 }
 # ...and, baseline or not, a pipelined dispatch that stops overlapping
 # at all is a regression in its own right: absolute floor on the
@@ -137,6 +159,10 @@ ABSOLUTE_MIN_KEYS = {
     # matter what the baseline measured: under ~10x offered load the
     # instance must keep serving >= 0.7x its own unloaded rate.
     "overload_goodput_ratio": 0.7,
+    # The lease tier's acceptance bar (docs/leases.md): the cooperative
+    # tier must cut server-served traffic by at least an order of
+    # magnitude on the steady-state admission stream.
+    "lease_traffic_reduction": 10.0,
 }
 # Absolute ceilings on the candidate, the MIN keys' mirror: telemetry
 # must stay effectively free (≤5% serving-rate cost with the flight
@@ -147,6 +173,10 @@ ABSOLUTE_MAX_KEYS = {
     # A saturated daemon sheds the excess; it must not buffer it into
     # RSS.  The overload phase may not grow peak RSS past this bound.
     "overload_rss_growth_mb": 2048,
+    # Lease accounting is batched on-device column work: one jitted
+    # scatter per grant/sync window, exactly — a candidate above 1.0
+    # re-introduced per-key dispatch (docs/leases.md).
+    "lease_dispatch_per_window": 1.0,
 }
 
 GATED_VALUE_KEYS = (
@@ -171,6 +201,8 @@ ABSOLUTE_ZERO_KEYS = (
     "mesh_dropped_keys",
     "mesh_double_served",
     "expired_served",
+    "lease_over_admission",
+    "lease_bucket_drift",
 )
 
 

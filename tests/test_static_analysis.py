@@ -492,9 +492,12 @@ def test_repo_hot_path_markers_present():
     fails loudly."""
     proj = load_project(REPO_ROOT, "gubernator_tpu")
     expected = {
+        # lease_window is the quota-lease column scatter (docs/leases.md
+        # — distinct from _lease_matrix's staging-slab lease): one
+        # batched dispatch per grant/sync window on the serving path.
         "gubernator_tpu/ops/engine.py": [
             "_build_cols", "_lease_matrix", "_promote_misses",
-            "submit_columns", "submit_cols", "submit"],
+            "submit_columns", "submit_cols", "submit", "lease_window"],
         # The sharded serving path: resolve + both dispatch formats
         # (device-routed flat and host-blocked fallback) all run per
         # serving window.
