@@ -110,7 +110,8 @@ class LeaseSession:
 
     def __init__(self, client, *, verifier=None, want_budget: int = 0,
                  offline_grace_ms: int = 5_000,
-                 max_offline_extensions: int = 3, clock=time.time):
+                 max_offline_extensions: int = 3, clock=time.time,
+                 holder_id: str = None):
         from gubernator_tpu.leases import LeaseCache
 
         self.client = client
@@ -118,6 +119,7 @@ class LeaseSession:
             clock=clock, verifier=verifier, want_budget=want_budget,
             offline_grace_ms=offline_grace_ms,
             max_offline_extensions=max_offline_extensions,
+            holder_id=holder_id,
         )
 
     async def admit(self, spec, hits: int = 1):

@@ -22,9 +22,10 @@ offline grace window instead of failing the caller.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _replace
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from gubernator_tpu.leases.protocol import (
@@ -64,11 +65,17 @@ class LeaseCache:
         want_budget: int = 0,
         offline_grace_ms: int = 5_000,
         max_offline_extensions: int = 3,
+        holder_id: Optional[str] = None,
     ):
         self._grant_fn = grant_fn
         self._sync_fn = sync_fn
         self._clock = clock
         self._verifier = verifier
+        # Leaseholder identity: the server accounts each holder's slice
+        # separately (several clients may lease the same key), so every
+        # outgoing spec/sync carries this cache's id.  Random per cache
+        # by default — two caches must never collide on one identity.
+        self.holder_id = holder_id or os.urandom(8).hex()
         self.want_budget = int(want_budget)
         self.offline_grace_ms = int(offline_grace_ms)
         self.max_offline_extensions = int(max_offline_extensions)
@@ -128,6 +135,7 @@ class LeaseCache:
                     out.append(LeaseSync(
                         name=name, key=key, consumed=rec.unsynced,
                         generation=rec.token.generation, release=release,
+                        holder=self.holder_id,
                     ))
         return out
 
@@ -234,11 +242,14 @@ class LeaseCache:
         return None
 
     def fill_want(self, spec: LeaseSpec) -> LeaseSpec:
-        """Spec with this cache's configured budget ask filled in."""
-        if self.want_budget and not spec.want:
-            from dataclasses import replace
-
-            return replace(spec, want=self.want_budget)
+        """Spec ready to send: this cache's budget ask and leaseholder
+        identity filled in (the server accounts slices per holder)."""
+        want = (
+            self.want_budget if self.want_budget and not spec.want
+            else spec.want
+        )
+        if want != spec.want or spec.holder != self.holder_id:
+            return _replace(spec, want=want, holder=self.holder_id)
         return spec
 
     # ------------------------------------------------------------------

@@ -12,15 +12,23 @@ batched decisions and one on-device column window per call:
 * **Reconcile** — a sync's unused budget flows back through the same
   decision path as *negative* hits (bucket_transition credits tokens
   for negative hits), so credit-back needs no new kernel either.
+* **Per-holder slices** — several clients may hold leases on the same
+  key concurrently, so a key's record carries one slice per leaseholder
+  (LeaseSpec/LeaseSync.holder): a sync credits back only the syncing
+  holder's unused slice, and cheap extension re-signs only the
+  requesting holder's budget — no holder can ever consume or refund
+  budget delegated to another.
 * **Column accounting** — outstanding budget, lease expiry, and
   generation live as device columns parallel to the SoA table
   (engine.lease_window): one jitted scatter per grant/sync window, no
-  per-key host dispatch, exported/restored with the snapshot.
+  per-key host dispatch, exported/restored with the snapshot.  Columns
+  mirror the per-key aggregate across holders.
 
 Under overload (tick_loop.under_pressure) grants degrade to *cheap
-extension*: re-sign the held budget with a pushed-out TTL — zero device
-work, zero decisions — so the lease tier sheds load exactly when the
-admission plane most needs it to (docs/overload.md).
+extension*: re-sign the requesting holder's held budget with a
+pushed-out TTL — zero device work, zero decisions — so the lease tier
+sheds load exactly when the admission plane most needs it to
+(docs/overload.md).
 """
 
 from __future__ import annotations
@@ -29,7 +37,7 @@ import asyncio
 import logging
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from gubernator_tpu.admission import CLASS_PEER
@@ -77,17 +85,34 @@ class LeaseConfig:
 
 
 @dataclass
-class _Held:
-    """Host record of one key's live delegation (the signing/authority
-    source of truth; the device columns mirror it for batch accounting
-    and snapshot survival)."""
+class _Slice:
+    """One leaseholder's live delegation on one key."""
 
     outstanding: int           # granted, not-yet-reconciled budget
     expires_ms: int
+
+
+@dataclass
+class _Held:
+    """Host record of one key's live delegations (the signing/authority
+    source of truth; the device columns mirror the per-key aggregate for
+    batch accounting and snapshot survival).  ``holders`` keys slices by
+    leaseholder identity so reconciles and extensions only ever touch
+    the syncing client's own budget."""
+
     generation: int
     limit: int
     duration: int
     algorithm: int
+    holders: Dict[str, _Slice] = field(default_factory=dict)
+
+    @property
+    def outstanding(self) -> int:
+        return sum(s.outstanding for s in self.holders.values())
+
+    @property
+    def expires_ms(self) -> int:
+        return max((s.expires_ms for s in self.holders.values()), default=0)
 
 
 class LeaseManager:
@@ -115,6 +140,14 @@ class LeaseManager:
         self.signer = signer or LeaseSigner(secret=self.config.secret)
         self._clock = clock
         self._held: Dict[Tuple[str, str], _Held] = {}
+        # Per-key generation high-water mark, surviving record removal:
+        # a release pops the record, but a recreated record must NOT
+        # restart at generation 1 or a partitioned client holding a
+        # token from the earlier incarnation could sync against the new
+        # one.  Generations are monotonic per key for the manager's
+        # lifetime (and per process restart the random HMAC secret /
+        # fresh ed25519 key already invalidates old tokens).
+        self._gen_floor: Dict[Tuple[str, str], int] = {}
         self._lock = threading.Lock()
         # Plain-int counters (the tick-loop delta-sync pattern mirrors
         # engine counters; these sync straight into prometheus families
@@ -123,6 +156,7 @@ class LeaseManager:
         self.metric_renewals = 0
         self.metric_revocations = 0
         self.metric_sync_loss = 0
+        self.metric_sync_dropped = 0
 
     # ------------------------------------------------------------------
     # Public async surface (daemon path)
@@ -142,13 +176,16 @@ class LeaseManager:
         self, syncs: Sequence[LeaseSync]
     ) -> List[LeaseSyncAck]:
         plan = self._plan_syncs(syncs)
+        responses = []
         if plan.reqs:
             # Reconcile traffic rides the peer admission class: syncs
             # carry already-admitted consumption, so shedding them loses
             # accounting while shedding a client decision loses nothing.
+            # _commit_syncs inspects the responses so that any shed or
+            # unapplied reconcile is at least counted, never silent.
             fut = self.tick_loop.submit(plan.reqs, klass=CLASS_PEER)
-            await asyncio.wrap_future(fut)
-        return self._commit_syncs(plan)
+            responses = await asyncio.wrap_future(fut)
+        return self._commit_syncs(plan, responses)
 
     # ------------------------------------------------------------------
     # Synchronous surface (engine-only: benches, virtual-clock tests)
@@ -166,9 +203,10 @@ class LeaseManager:
         self, syncs: Sequence[LeaseSync], now_ms: Optional[int] = None
     ) -> List[LeaseSyncAck]:
         plan = self._plan_syncs(syncs, now_ms)
-        if plan.reqs:
-            self.engine.process(plan.reqs, now=now_ms)
-        return self._commit_syncs(plan, now_ms)
+        responses = (
+            self.engine.process(plan.reqs, now=now_ms) if plan.reqs else []
+        )
+        return self._commit_syncs(plan, responses, now_ms)
 
     # ------------------------------------------------------------------
     # Grant planning/commit
@@ -208,29 +246,33 @@ class LeaseManager:
                     rec.limit != spec.limit
                     or rec.duration != spec.duration
                 ):
-                    # Config changed: revoke the generation.  The old
-                    # outstanding stays charged until the client's sync
+                    # Config changed: revoke the generation.  Every
+                    # holder's outstanding stays charged until its sync
                     # reconciles it (a stale-generation sync is handled
                     # conservatively, never credited).
                     rec.generation += 1
                     rec.limit = spec.limit
                     rec.duration = spec.duration
-                    rec.outstanding = 0
+                    rec.holders.clear()
                     self.metric_revocations += 1
                     if self.metrics is not None:
                         self.metrics.lease_revocations.inc()
-                if (
-                    pressure
-                    and rec is not None
-                    and rec.outstanding > 0
-                    and rec.limit == spec.limit
-                ):
-                    # Overload degrade (docs/overload.md): extend the
-                    # held budget's TTL — no decision, no device work.
-                    rec.expires_ms = now + self.config.ttl_ms
+                sl = (
+                    rec.holders.get(spec.holder)
+                    if rec is not None else None
+                )
+                if pressure and sl is not None and sl.outstanding > 0:
+                    # Overload degrade (docs/overload.md): extend ONLY
+                    # the requesting holder's held slice — no decision,
+                    # no device work.  Another holder's budget is never
+                    # re-minted here: with N holders on one key, each
+                    # extension re-signs that client's own slice, so the
+                    # sum of live token budgets never exceeds what was
+                    # charged at grant time.
+                    sl.expires_ms = now + self.config.ttl_ms
                     plan.cheap[i] = self.signer.mint(
-                        spec.name, spec.key, rec.outstanding,
-                        rec.expires_ms, rec.generation,
+                        spec.name, spec.key, sl.outstanding,
+                        sl.expires_ms, rec.generation,
                     )
                     self.metric_renewals += 1
                     if self.metrics is not None:
@@ -261,22 +303,31 @@ class LeaseManager:
                 resp = responses[j]
                 k = (spec.name, spec.key)
                 rec = self._held.get(k)
-                if resp.status != Status.UNDER_LIMIT:
-                    # Bucket too hot to delegate: no budget charged (an
-                    # over-limit decision consumes nothing), no token —
-                    # the client falls back to per-request decisions.
+                if resp.status != Status.UNDER_LIMIT or getattr(
+                        resp, "error", ""):
+                    # Bucket too hot to delegate, or the decision was
+                    # shed with a retriable error (nothing was charged):
+                    # no token — the client falls back to per-request
+                    # decisions or retries the grant.
                     continue
                 budget = plan.budgets[j]
                 if rec is None:
+                    # Recreated records continue from the per-key
+                    # generation high-water mark, never restart at 1 —
+                    # tokens from a released/revoked incarnation must
+                    # stay stale forever.
                     rec = self._held[k] = _Held(
-                        outstanding=0, expires_ms=0, generation=1,
+                        generation=self._gen_floor.get(k, 0) + 1,
                         limit=spec.limit, duration=spec.duration,
                         algorithm=spec.algorithm,
                     )
-                rec.outstanding += budget
-                rec.expires_ms = now + self.config.ttl_ms
+                sl = rec.holders.get(spec.holder)
+                if sl is None:
+                    sl = rec.holders[spec.holder] = _Slice(0, 0)
+                sl.outstanding += budget
+                sl.expires_ms = now + self.config.ttl_ms
                 out[i] = self.signer.mint(
-                    spec.name, spec.key, budget, rec.expires_ms,
+                    spec.name, spec.key, budget, sl.expires_ms,
                     rec.generation,
                 )
                 self.metric_grants += 1
@@ -295,33 +346,48 @@ class LeaseManager:
     class _SyncPlan:
         syncs: List[LeaseSync]
         reqs: List[RateLimitRequest]
+        req_meta: List[Tuple[str, int]]   # ("credit"|"charge", amount)
         acks: List[LeaseSyncAck]
         col_keys: List[bytes]
         col_vals: List[Tuple[int, int, int]]
 
     def _plan_syncs(self, syncs, now_ms=None) -> "_SyncPlan":
         now = self._now_ms(now_ms)
-        plan = self._SyncPlan(list(syncs), [], [], [], [])
+        plan = self._SyncPlan(list(syncs), [], [], [], [], [])
         with self._lock:
             for s in plan.syncs:
                 k = (s.name, s.key)
                 rec = self._held.get(k)
-                stale = rec is None or rec.generation != s.generation
-                outstanding = 0 if stale else rec.outstanding
-                applied = min(max(s.consumed, 0), outstanding)
-                excess = max(s.consumed, 0) - applied
+                sl = rec.holders.get(s.holder) if rec is not None else None
+                # A known key with a matching generation but no slice
+                # for this holder is still stale: whatever this client
+                # consumed was never delegated by the live record.
+                stale = (
+                    rec is None
+                    or rec.generation != s.generation
+                    or sl is None
+                )
+                consumed = max(s.consumed, 0)
+                applied = 0 if stale else min(consumed, sl.outstanding)
+                excess = consumed - applied
                 credited = 0
                 if not stale:
-                    rec.outstanding -= applied
-                    done = s.release or rec.expires_ms <= now
+                    sl.outstanding -= applied
+                    done = s.release or sl.expires_ms <= now
                     if done:
                         credited = (
-                            rec.outstanding if self.config.credit_back else 0
+                            sl.outstanding if self.config.credit_back else 0
                         )
-                        unused = rec.outstanding
-                        rec.outstanding = 0
-                        if s.release:
+                        unused = sl.outstanding
+                        sl.outstanding = 0
+                        # Only THIS holder's slice ends here — budget
+                        # still delegated to other holders of the same
+                        # key stays outstanding (their signed tokens
+                        # remain live until their own sync/expiry).
+                        rec.holders.pop(s.holder, None)
+                        if s.release and not rec.holders:
                             self._held.pop(k, None)
+                            self._gen_floor[k] = rec.generation
                         if credited > 0:
                             # Unused delegated budget flows back through
                             # the normal decision path: negative hits
@@ -333,27 +399,48 @@ class LeaseManager:
                                 limit=rec.limit, duration=rec.duration,
                                 algorithm=rec.algorithm,
                             ))
+                            plan.req_meta.append(("credit", credited))
                         elif unused:
                             pass  # credit-back disabled: stays charged
+                charged = 0
                 if excess > 0:
                     # Consumption beyond the grant (misbehaving or
-                    # recovered client): force-charge it so the bucket
-                    # reflects reality, and count the over-admission.
+                    # recovered client): count the over-admission, and
+                    # force-charge it so the bucket reflects reality.
                     self.metric_sync_loss += excess
                     if self.metrics is not None:
                         self.metrics.lease_sync_loss.inc(excess)
-                    ref = rec if not stale else None
-                    plan.reqs.append(RateLimitRequest(
-                        name=s.name, unique_key=s.key, hits=excess,
-                        limit=ref.limit if ref else 0,
-                        duration=ref.duration if ref else 60_000,
-                        algorithm=ref.algorithm if ref else 0,
-                    ))
+                    if rec is not None:
+                        # Stale generation ≠ unknown config: the record
+                        # keeps the real (limit, duration), so the charge
+                        # lands as an ordinary decision instead of a
+                        # limit=0 config change that bucket_transition
+                        # would clamp to the floor (ops/buckets.py).
+                        plan.reqs.append(RateLimitRequest(
+                            name=s.name, unique_key=s.key, hits=excess,
+                            limit=rec.limit, duration=rec.duration,
+                            algorithm=rec.algorithm,
+                        ))
+                        plan.req_meta.append(("charge", excess))
+                        charged = excess
+                    else:
+                        # No config known for this key at all: a made-up
+                        # limit would corrupt the bucket's config, so the
+                        # excess is recorded as dropped accounting rather
+                        # than charged.
+                        self.metric_sync_dropped += excess
+                        if self.metrics is not None:
+                            self.metrics.lease_sync_dropped.inc(excess)
+                if rec is not None:
+                    ack_gen = rec.generation
+                else:
+                    ack_gen = max(
+                        self._gen_floor.get(k, 0), s.generation) + 1
                 plan.acks.append(LeaseSyncAck(
                     accepted=not stale,
-                    generation=rec.generation if rec else s.generation + 1,
+                    generation=ack_gen,
                     credited=credited,
-                    charged=excess,
+                    charged=charged,
                 ))
                 if not stale:
                     plan.col_keys.append(
@@ -362,8 +449,27 @@ class LeaseManager:
                         rec.outstanding, rec.expires_ms, rec.generation))
         return plan
 
-    def _commit_syncs(self, plan: "_SyncPlan",
+    def _commit_syncs(self, plan: "_SyncPlan", responses=(),
                       now_ms=None) -> List[LeaseSyncAck]:
+        # The host records were already mutated in _plan_syncs; if the
+        # peer-class batch was shed (per-item retriable error) or a
+        # force-charge bounced off the bucket floor (OVER_LIMIT consumes
+        # nothing), the bucket never received the credit/charge.  That
+        # drift cannot be rolled back safely — the ack may already be
+        # promised — so it is counted and logged, never silent.
+        dropped = 0
+        for resp, (kind, amount) in zip(responses, plan.req_meta):
+            if getattr(resp, "error", ""):
+                dropped += amount
+            elif kind == "charge" and resp.status != Status.UNDER_LIMIT:
+                dropped += amount
+        if dropped:
+            self.metric_sync_dropped += dropped
+            if self.metrics is not None:
+                self.metrics.lease_sync_dropped.inc(dropped)
+            log.warning(
+                "lease reconcile lost %d admissions of bucket "
+                "accounting (shed or unapplied credit/charge)", dropped)
         self._apply_columns(plan.col_keys, plan.col_vals, is_set=True)
         return plan.acks
 
@@ -389,14 +495,14 @@ class LeaseManager:
 
     # ------------------------------------------------------------------
     def revoke(self, name: str, key: str) -> bool:
-        """Explicit revocation: bump the generation so outstanding
-        tokens die at their next sync/renewal."""
+        """Explicit revocation: bump the generation so every holder's
+        outstanding tokens die at their next sync/renewal."""
         with self._lock:
             rec = self._held.get((name, key))
             if rec is None:
                 return False
             rec.generation += 1
-            rec.outstanding = 0
+            rec.holders.clear()
             self.metric_revocations += 1
             if self.metrics is not None:
                 self.metrics.lease_revocations.inc()
@@ -414,10 +520,14 @@ class LeaseManager:
         with self._lock:
             return {
                 "held": len(self._held),
+                "holders": sum(
+                    len(r.holders) for r in self._held.values()
+                ),
                 "grants": self.metric_grants,
                 "renewals": self.metric_renewals,
                 "revocations": self.metric_revocations,
                 "sync_loss": self.metric_sync_loss,
+                "sync_dropped": self.metric_sync_dropped,
                 "outstanding_total": sum(
                     r.outstanding for r in self._held.values()
                 ),

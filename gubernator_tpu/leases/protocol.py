@@ -12,6 +12,15 @@ exhausts, or is revoked, at which point it syncs the consumed count back
 ``generation`` is the revocation handle: the server bumps it whenever the
 limit's configuration changes, and a sync carrying a stale generation is
 reconciled conservatively (no credit-back) instead of trusted.
+
+``holder`` is the leaseholder identity: several clients may hold leases
+on the same key at once, so every spec and sync names the client it
+belongs to and the server accounts each holder's delegated slice
+separately — one holder's release or renewal can never credit back (or
+re-mint) budget delegated to another.  :class:`~gubernator_tpu.leases
+.cache.LeaseCache` stamps its own id automatically; callers driving the
+manager directly pick any stable string (empty is a valid — shared —
+identity).
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ class LeaseSpec:
     algorithm: int = 0         # types.Algorithm (0 = TOKEN_BUCKET)
     burst: int = 0
     want: int = 0              # requested budget; 0 = server default
+    holder: str = ""           # leaseholder identity (per-client slice)
 
     @property
     def full_key(self) -> str:
@@ -66,6 +76,7 @@ class LeaseSync:
     consumed: int              # admissions consumed since the last sync
     generation: int            # generation of the lease consumed under
     release: bool = False      # True = lease is done; credit unused back
+    holder: str = ""           # leaseholder identity (per-client slice)
 
     @property
     def full_key(self) -> str:

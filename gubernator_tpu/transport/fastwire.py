@@ -289,9 +289,15 @@ def encode_resp(mat: np.ndarray) -> bytes:
 
 import struct as _struct
 
-_LEASE_GRANT_REQ_MAGIC = b"GLR1"
+# Request frames are v2: they carry the leaseholder identity (the
+# server accounts per-holder slices — docs/leases.md).  Parsers still
+# accept the v1 frames (no holder field → the shared "" identity) so a
+# not-yet-upgraded client keeps working against a v2 server.
+_LEASE_GRANT_REQ_MAGIC = b"GLR2"
+_LEASE_GRANT_REQ_MAGIC_V1 = b"GLR1"
 _LEASE_GRANT_RESP_MAGIC = b"GLT1"
-_LEASE_SYNC_REQ_MAGIC = b"GSY1"
+_LEASE_SYNC_REQ_MAGIC = b"GSY2"
+_LEASE_SYNC_REQ_MAGIC_V1 = b"GSY1"
 _LEASE_SYNC_RESP_MAGIC = b"GSA1"
 
 
@@ -314,6 +320,7 @@ def encode_lease_grant_req(specs) -> bytes:
             "<qqqqq", s.limit, s.duration, s.algorithm, s.burst, s.want))
         parts.append(_pack_str(s.name))
         parts.append(_pack_str(s.key))
+        parts.append(_pack_str(s.holder))
     return b"".join(parts)
 
 
@@ -322,8 +329,11 @@ def parse_lease_grant_req(data: bytes):
     from gubernator_tpu.leases.protocol import LeaseSpec
 
     try:
-        if data[:4] != _LEASE_GRANT_REQ_MAGIC:
+        magic = data[:4]
+        if magic not in (_LEASE_GRANT_REQ_MAGIC,
+                         _LEASE_GRANT_REQ_MAGIC_V1):
             return None
+        v1 = magic == _LEASE_GRANT_REQ_MAGIC_V1
         (n,) = _struct.unpack_from("<I", data, 4)
         off = 8
         out = []
@@ -333,9 +343,12 @@ def parse_lease_grant_req(data: bytes):
             off += 40
             name, off = _unpack_str(data, off)
             key, off = _unpack_str(data, off)
+            holder = ""
+            if not v1:
+                holder, off = _unpack_str(data, off)
             out.append(LeaseSpec(
                 name=name, key=key, limit=limit, duration=duration,
-                algorithm=algo, burst=burst, want=want))
+                algorithm=algo, burst=burst, want=want, holder=holder))
         return out if off == len(data) else None
     except (_struct.error, IndexError, UnicodeDecodeError):
         return None
@@ -400,6 +413,7 @@ def encode_lease_sync_req(syncs) -> bytes:
             "<qqB", s.consumed, s.generation, 1 if s.release else 0))
         parts.append(_pack_str(s.name))
         parts.append(_pack_str(s.key))
+        parts.append(_pack_str(s.holder))
     return b"".join(parts)
 
 
@@ -408,8 +422,11 @@ def parse_lease_sync_req(data: bytes):
     from gubernator_tpu.leases.protocol import LeaseSync
 
     try:
-        if data[:4] != _LEASE_SYNC_REQ_MAGIC:
+        magic = data[:4]
+        if magic not in (_LEASE_SYNC_REQ_MAGIC,
+                         _LEASE_SYNC_REQ_MAGIC_V1):
             return None
+        v1 = magic == _LEASE_SYNC_REQ_MAGIC_V1
         (n,) = _struct.unpack_from("<I", data, 4)
         off = 8
         out = []
@@ -418,9 +435,12 @@ def parse_lease_sync_req(data: bytes):
             off += 17
             name, off = _unpack_str(data, off)
             key, off = _unpack_str(data, off)
+            holder = ""
+            if not v1:
+                holder, off = _unpack_str(data, off)
             out.append(LeaseSync(
                 name=name, key=key, consumed=consumed, generation=gen,
-                release=bool(release)))
+                release=bool(release), holder=holder))
         return out if off == len(data) else None
     except (_struct.error, IndexError, UnicodeDecodeError):
         return None

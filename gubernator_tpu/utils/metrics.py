@@ -615,6 +615,14 @@ class Metrics:
             "force-charged to the bucket on reconcile.",
             registry=reg,
         )
+        self.lease_sync_dropped = Counter(
+            "gubernator_tpu_lease_sync_dropped",
+            "Lease reconcile accounting that never reached the bucket: "
+            "credit/charge decisions shed under overload, force-charges "
+            "bounced off the bucket floor, or excess synced against a "
+            "key with no known config.",
+            registry=reg,
+        )
 
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``

@@ -39,9 +39,9 @@ def sim():
     return Sim()
 
 
-def _spec(key, limit=1_000, duration=60_000, want=0):
+def _spec(key, limit=1_000, duration=60_000, want=0, holder=""):
     return LeaseSpec(name="lease_t", key=key, limit=limit,
-                     duration=duration, want=want)
+                     duration=duration, want=want, holder=holder)
 
 
 def _mgr(sim, clk=None, **cfg):
@@ -218,6 +218,222 @@ def test_pressure_degrades_grant_to_cheap_extension(sim):
     assert mgr.verifier().verify(t2)
     assert mgr.metric_renewals == 1
     assert _remaining(sim, "pr") == before
+
+
+# ----------------------------------------------------------------------
+# Per-leaseholder accounting: concurrent holders on one key
+# ----------------------------------------------------------------------
+
+def test_release_credits_only_the_syncing_holders_slice(sim):
+    mgr, _ = _mgr(sim)
+    [ta] = mgr.grant_local([_spec("mh", want=30, holder="A")],
+                           now_ms=sim.now)
+    [tb] = mgr.grant_local([_spec("mh", want=50, holder="B")],
+                           now_ms=sim.now)
+    assert ta.budget == 30 and tb.budget == 50
+    assert _remaining(sim, "mh") == 1_000 - 80
+    # A releases having consumed 10: only A's 20 unused come back.
+    # B's 50 are still delegated (its signed token is live) and MUST
+    # stay charged, or B's local admissions would over-admit the bucket.
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="mh", consumed=10,
+                   generation=ta.generation, release=True, holder="A")],
+        now_ms=sim.now)
+    assert ack.accepted and ack.credited == 20
+    assert _remaining(sim, "mh") == 1_000 - 50 - 10
+    assert mgr.outstanding("lease_t", "mh") == 50
+    # B's own release reconciles only B's slice.
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="mh", consumed=50,
+                   generation=tb.generation, release=True, holder="B")],
+        now_ms=sim.now)
+    assert ack.accepted and ack.credited == 0
+    assert _remaining(sim, "mh") == 1_000 - 60
+    assert mgr.outstanding("lease_t", "mh") == 0
+
+
+def test_pressure_extension_is_per_holder_slice(sim):
+    class _Loop:
+        def under_pressure(self):
+            return True
+
+    mgr, _ = _mgr(sim)
+    [ta] = mgr.grant_local([_spec("ph", want=25, holder="A")],
+                           now_ms=sim.now)
+    [tb] = mgr.grant_local([_spec("ph", want=40, holder="B")],
+                           now_ms=sim.now)
+    mgr.tick_loop = _Loop()
+    # Each renewing holder gets ONLY its own slice re-signed — never the
+    # key's pooled outstanding (which would let N clients each admit the
+    # whole pool locally).
+    [ea] = mgr.grant_local([_spec("ph", want=25, holder="A")],
+                           now_ms=sim.now + 1_000)
+    [eb] = mgr.grant_local([_spec("ph", want=40, holder="B")],
+                           now_ms=sim.now + 1_000)
+    assert ea.budget == 25 and eb.budget == 40
+    assert mgr.metric_renewals == 2
+    assert _remaining(sim, "ph") == 1_000 - 65  # no new charge
+    # A holder with nothing held gets a normal (charged) decision even
+    # under pressure — never a free extension of someone else's budget.
+    [tc] = mgr.grant_local([_spec("ph", want=10, holder="C")],
+                           now_ms=sim.now + 1_000)
+    assert tc is not None and tc.budget == 10
+    assert _remaining(sim, "ph") == 1_000 - 75
+
+
+def test_two_caches_on_one_key_never_over_admit(sim):
+    # budget_fraction=0.5 lets each cache's want=30 through the
+    # per-grant cap on a limit-100 bucket.
+    mgr, clk = _mgr(sim, budget_fraction=0.5)
+
+    def mk_cache():
+        return LeaseCache(
+            lambda s: mgr.grant_local(s, now_ms=int(clk() * 1000)),
+            lambda s: mgr.sync_local(s, now_ms=int(clk() * 1000)),
+            clock=clk, verifier=mgr.verifier(), want_budget=30)
+
+    a, b = mk_cache(), mk_cache()
+    assert a.holder_id != b.holder_id
+    spec = _spec("mc", limit=100)
+    assert a.admit(spec) is True
+    assert b.admit(spec) is True
+    assert _remaining(sim, "mc", limit=100) == 100 - 60
+    # A's shutdown release credits back only A's 29 unused admissions.
+    assert a.close(deadline=clk() + 5.0) == 0
+    assert mgr.outstanding("lease_t", "mc") == 30
+    assert _remaining(sim, "mc", limit=100) == 100 - 30 - 1
+    # B self-enforces against its own 30-budget slice, nothing more.
+    for _ in range(29):
+        assert b.admit(spec) is True
+    assert b.metric_local_admits == 30
+    assert b.close(deadline=clk() + 5.0) == 0
+    assert mgr.outstanding("lease_t", "mc") == 0
+    # Joint invariant: bucket reflects exactly the 31 admissions.
+    assert _remaining(sim, "mc", limit=100) == 100 - 31
+
+
+def test_generation_is_monotonic_across_release_and_regrant(sim):
+    mgr, _ = _mgr(sim)
+    [t1] = mgr.grant_local([_spec("gm", want=20, holder="A")],
+                           now_ms=sim.now)
+    assert t1.generation == 1
+    mgr.sync_local(
+        [LeaseSync(name="lease_t", key="gm", consumed=20,
+                   generation=1, release=True, holder="A")],
+        now_ms=sim.now)
+    # The record was popped; a recreated record must NOT restart at
+    # generation 1 — a partitioned client still holding a token from
+    # the first incarnation has to stay stale forever.
+    [t2] = mgr.grant_local([_spec("gm", want=20, holder="B")],
+                           now_ms=sim.now)
+    assert t2.generation == 2
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="gm", consumed=5,
+                   generation=t1.generation, release=True, holder="A")],
+        now_ms=sim.now)
+    assert not ack.accepted
+    assert ack.generation == 2
+
+
+def test_unknown_holder_sync_is_stale(sim):
+    mgr, _ = _mgr(sim)
+    [tok] = mgr.grant_local([_spec("uh", want=20, holder="A")],
+                            now_ms=sim.now)
+    # Right key, right generation, wrong holder: nothing was delegated
+    # to B, so its consumption is excess (force-charged), never applied
+    # against A's slice.
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="uh", consumed=5,
+                   generation=tok.generation, release=True, holder="B")],
+        now_ms=sim.now)
+    assert not ack.accepted and ack.charged == 5
+    assert mgr.outstanding("lease_t", "uh") == 20
+    assert _remaining(sim, "uh") == 1_000 - 20 - 5
+
+
+# ----------------------------------------------------------------------
+# Reconcile edge cases: stale configs, shed decisions, unknown keys
+# ----------------------------------------------------------------------
+
+def test_stale_generation_excess_charged_with_known_config(sim):
+    mgr, _ = _mgr(sim)
+    [tok] = mgr.grant_local([_spec("sg", want=20, holder="A")],
+                            now_ms=sim.now)
+    assert mgr.revoke("lease_t", "sg")
+    # The stale sync's excess must be force-charged under the record's
+    # REAL config — a limit=0 charge would be treated as a config change
+    # by bucket_transition (remaining clamped, limit zeroed) and deny
+    # legitimate traffic afterwards.
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="sg", consumed=25,
+                   generation=tok.generation, release=True, holder="A")],
+        now_ms=sim.now)
+    assert not ack.accepted and ack.charged == 25
+    assert mgr.metric_sync_loss == 25
+    assert _remaining(sim, "sg") == 1_000 - 20 - 25
+
+
+def test_unknown_key_excess_is_dropped_not_mischarged(sim):
+    mgr, _ = _mgr(sim)
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="nokey", consumed=7,
+                   generation=3, release=True, holder="A")],
+        now_ms=sim.now)
+    # No record, no config: charging with an invented limit would
+    # corrupt the bucket, so the excess is counted as dropped instead.
+    assert not ack.accepted and ack.charged == 0
+    assert ack.generation == 4
+    assert mgr.metric_sync_loss == 7
+    assert mgr.metric_sync_dropped == 7
+    assert _remaining(sim, "nokey") == 1_000  # bucket untouched
+
+
+class _ShedEngine:
+    """Engine stub whose every decision is a retriable shed answer."""
+
+    def __init__(self, msg="request shed: tick loop shutting down"):
+        self.msg = msg
+
+    def process(self, reqs, now=None):
+        from gubernator_tpu.types import RateLimitResponse
+
+        return [RateLimitResponse(error=self.msg) for _ in reqs]
+
+
+def test_shed_sync_credit_is_counted_not_silent(sim):
+    mgr, _ = _mgr(sim)
+    [tok] = mgr.grant_local([_spec("sh", want=20, holder="A")],
+                            now_ms=sim.now)
+    # The release's credit-back decision gets shed: the host record was
+    # already reconciled, so the drift (15 credits that never reached
+    # the bucket) must at least be counted and logged.
+    mgr.engine = _ShedEngine()
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="sh", consumed=5,
+                   generation=tok.generation, release=True, holder="A")],
+        now_ms=sim.now)
+    assert ack.accepted and ack.credited == 15
+    assert mgr.metric_sync_dropped == 15
+    assert mgr.outstanding("lease_t", "sh") == 0
+
+
+def test_bounced_force_charge_is_counted(sim):
+    mgr, _ = _mgr(sim)
+    [tok] = mgr.grant_local([_spec("bf", want=10, holder="A")],
+                            now_ms=sim.now)
+    # Drain the bucket to the floor, then sync 15 admissions beyond the
+    # grant: the force-charge resolves OVER_LIMIT (consumes nothing), so
+    # the excess never reached the bucket — counted as dropped.
+    sim.hit(name="lease_t", unique_key="bf", hits=990, limit=1_000,
+            duration=60_000)
+    [ack] = mgr.sync_local(
+        [LeaseSync(name="lease_t", key="bf", consumed=25,
+                   generation=tok.generation, release=True, holder="A")],
+        now_ms=sim.now)
+    assert ack.charged == 15
+    assert mgr.metric_sync_loss == 15
+    assert mgr.metric_sync_dropped == 15
+    assert _remaining(sim, "bf") == 0
 
 
 # ----------------------------------------------------------------------
@@ -429,7 +645,7 @@ def test_fastwire_lease_frames_round_trip():
     from gubernator_tpu.transport import fastwire as fw
 
     specs = [LeaseSpec("n1", "k1", 100, 60_000, algorithm=1, burst=5,
-                       want=25),
+                       want=25, holder="client-a"),
              LeaseSpec("n2", "k2", 7, 1_000)]
     assert fw.parse_lease_grant_req(
         fw.encode_lease_grant_req(specs)) == specs
@@ -440,7 +656,8 @@ def test_fastwire_lease_frames_round_trip():
     assert fw.parse_lease_grant_resp(
         fw.encode_lease_grant_resp(tokens)) == tokens
 
-    syncs = [LeaseSync("n1", "k1", 13, 2, release=True),
+    syncs = [LeaseSync("n1", "k1", 13, 2, release=True,
+                       holder="client-a"),
              LeaseSync("n2", "k2", 0, 1)]
     assert fw.parse_lease_sync_req(
         fw.encode_lease_sync_req(syncs)) == syncs
@@ -449,6 +666,29 @@ def test_fastwire_lease_frames_round_trip():
             LeaseSyncAck(False, 9, charged=3)]
     assert fw.parse_lease_sync_resp(
         fw.encode_lease_sync_resp(acks)) == acks
+
+
+def test_fastwire_lease_v1_request_frames_still_parse():
+    # Pre-holder (v1) request frames carry no holder string; a v2 server
+    # must keep parsing them as the shared "" identity so an older
+    # client does not break mid-rollout.
+    import struct
+
+    from gubernator_tpu.transport import fastwire as fw
+
+    def ps(s):
+        b = s.encode()
+        return struct.pack("<H", len(b)) + b
+
+    grant_v1 = (b"GLR1" + struct.pack("<I", 1)
+                + struct.pack("<qqqqq", 5, 1_000, 0, 0, 2)
+                + ps("n") + ps("k"))
+    assert fw.parse_lease_grant_req(grant_v1) == [
+        LeaseSpec("n", "k", 5, 1_000, want=2)]
+    sync_v1 = (b"GSY1" + struct.pack("<I", 1)
+               + struct.pack("<qqB", 3, 1, 1) + ps("n") + ps("k"))
+    assert fw.parse_lease_sync_req(sync_v1) == [
+        LeaseSync("n", "k", 3, 1, release=True)]
 
 
 def test_fastwire_lease_frames_reject_malformed():
