@@ -663,6 +663,130 @@ def rung_churn(label="engine_churn_4x", capacity=None, ws_mult=4,
     return out
 
 
+def rung_churn_ssd(label="engine_churn_ssd"):
+    """Three-tier churn ladder (docs/tiering.md): working set 8x the
+    combined RAM tiers (hot + cold), with the SSD slab store absorbing
+    everything RAM can't hold.  Uniform-random traffic over the working
+    set keeps most of each batch out of the hot tier, so every tick
+    exercises the full demote chain (hot → cold → SSD write-behind) AND
+    the three-hop miss path (hot miss → cold miss → batched slab
+    lookup → one merged restore scatter).
+
+    Gated invariants (scripts/check_bench_regression.py):
+
+    * ``ssd_continuity_errors`` — probe keys whose consumed budget did
+      NOT survive a hot→cold→SSD→hot round trip through the slab files
+      (ABSOLUTE_ZERO: an SSD-tier reset is the same rate-limit bypass
+      the cold tier closed one level up),
+    * ``ssd_tick_path_reads`` — slab lookups observed inside the
+      tick-dispatch block (ABSOLUTE_ZERO: SSD I/O must never land in
+      the tick or pack stages),
+    * ``ssd_promote_batches_per_miss_tick`` — slab lookups per tick
+      that had cold misses (ceiling 1.0: the third hop is ONE batched
+      lookup, never per-key reads),
+    * ``churn_ssd_rss_mb`` — resident-set growth across the rung
+      (absolute ceiling: the 8x working set lives on flash, not RAM).
+    """
+    import resource
+    import shutil
+    import tempfile
+
+    from gubernator_tpu.ops.engine import TickEngine, resolve_ticks
+    from gubernator_tpu.tiering import SsdStore
+
+    def rss_mb():
+        try:  # current residency, not the process-lifetime peak (other
+            # rungs ran first); falls back to ru_maxrss off-Linux.
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+        except (OSError, ValueError):
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024
+
+    now = 1_700_000_000_000
+    hot = 1 << 12 if FAST else 1 << 14
+    cold = hot
+    n_keys = 8 * (hot + cold)
+    batch = 4096
+    ticks = 24 if FAST else 96
+    tmpdir = tempfile.mkdtemp(prefix="guber-bench-ssd-")
+    ssd = SsdStore(tmpdir, capacity_bytes=1 << 31)
+    engine = TickEngine(
+        capacity=hot, max_batch=batch, cold_capacity=cold, ssd=ssd
+    )
+    try:
+        rss0 = rss_mb()
+        # Continuity probes: consume budget on keys OUTSIDE the churn id
+        # range, push them hot → cold → SSD with the prefill, then
+        # re-touch and check the budget survived the full round trip.
+        n_probe = 8
+        probe_ids = np.arange(10**9, 10**9 + n_probe)
+        engine.process_columns(
+            _cols(probe_ids, 1_000_000, 3_600_000, 0, hits=7), now=now
+        )
+        fill_s = _prefill(engine, n_keys, 0, now, chunk=batch)
+        ssd.flush()  # probes read back from slab files, not RAM staging
+        mat, _ = engine.process_columns(
+            _cols(probe_ids, 1_000_000, 3_600_000, 0, hits=1), now=now
+        )
+        continuity_errors = int(np.sum(mat[2] != 1_000_000 - 7 - 1))
+
+        rng = np.random.default_rng(11)
+        batches = [
+            _cols(rng.integers(0, n_keys, batch), 1_000_000, 3_600_000, 0)
+            for _ in range(min(ticks, 16))
+        ]
+        seg_rates = []
+        tick_i = 0
+        for seg_ticks in [ticks // 3] * 2 + [ticks - 2 * (ticks // 3)]:
+            s0 = time.perf_counter()
+            pending = []
+            for _ in range(seg_ticks):
+                pending.append(
+                    engine.submit_columns(batches[tick_i % len(batches)],
+                                          now + tick_i)
+                )
+                tick_i += 1
+                if len(pending) >= 16:
+                    resolve_ticks(pending)
+                    pending.clear()
+            resolve_ticks(pending)
+            seg_rates.append(
+                seg_ticks * batch / max(time.perf_counter() - s0, 1e-9))
+        rss1 = rss_mb()
+        seg = sorted(seg_rates)
+        st = ssd.stats()
+        return {
+            "rung": label,
+            "keys": n_keys,
+            "capacity": hot,
+            "cold_capacity": cold,
+            "batch": batch,
+            "fill_s": round(fill_s, 1),
+            "decisions_per_sec": round(seg[len(seg) // 2], 1),
+            "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
+            "cold_hits": engine.metric_cold_hits,
+            "ssd_hits": engine.metric_ssd_hits,
+            "ssd_size": st["size"],
+            "ssd_bytes": st["bytes"],
+            "ssd_slabs": st["slabs"],
+            "ssd_write_batches": st["write_batches"],
+            "ssd_backpressure": st["backpressure"],
+            "ssd_compactions": st["compactions"],
+            # Exact work counts / invariants (gated without slack).
+            "ssd_continuity_errors": continuity_errors,
+            "ssd_tick_path_reads": engine.metric_ssd_tick_path_reads,
+            "ssd_promote_batches_per_miss_tick": round(
+                engine.metric_ssd_lookups
+                / max(1, engine.metric_ssd_miss_ticks), 4),
+            "churn_ssd_rss_mb": round(max(0.0, rss1 - rss0), 1),
+        }
+    finally:
+        engine.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def rung_herd_device():
     """Transport-free herd evidence: chained-``fori_loop`` differential
     ticks (the kernel_1m methodology) for 4096-batch shapes on one
@@ -2642,6 +2766,7 @@ def main():
 
     ladder.append(_safe("p99_projection", rung_p99_projection))
     ladder.append(_safe("engine_churn_4x", rung_churn))
+    ladder.append(_safe("engine_churn_ssd", rung_churn_ssd))
     ladder.append(_safe("herd_device", rung_herd_device))
     ladder.append(_safe(
         "herd_token_4096", lambda: rung_herd(unique_dps, 0, "herd_token_4096")
@@ -2862,6 +2987,12 @@ def compact_headline(record, ladder_file):
         # ~10x load must hold its floor, RSS growth is bounded.
         "expired_served", "overload_admitted_p99_ms",
         "overload_goodput_ratio", "overload_rss_growth_mb",
+        # SSD-tier gates (docs/tiering.md): continuity through the slab
+        # files and zero tick-path reads are ABSOLUTE_ZERO, the batched
+        # third hop is capped at one lookup per miss tick, RSS growth
+        # across the 8x working set is absolutely bounded.
+        "ssd_continuity_errors", "ssd_tick_path_reads",
+        "ssd_promote_batches_per_miss_tick", "churn_ssd_rss_mb",
     )
     count_map = {}
     for r in record["ladder"]:

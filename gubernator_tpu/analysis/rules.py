@@ -78,6 +78,11 @@ _G001_CALLS = {
     "jax.block_until_ready": "jax.block_until_ready",
 }
 _G001_ASARRAY_BASES = {"np", "numpy", "onp"}
+# Blocking file syscalls are the SSD-tier twin of a device sync: a
+# per-tick open()/fsync()/mmap() stalls the dispatch thread on storage
+# latency instead of PCIe.  Slab I/O belongs on the background writer
+# (SsdStore._writer_loop) or in non-hot helpers (_map_slab).
+_G001_FILE_CALLS = {"open", "os.open", "os.fsync", "mmap.mmap"}
 
 
 def _g001(project: Project) -> Iterable[Finding]:
@@ -100,8 +105,11 @@ def _g001(project: Project) -> Iterable[Finding]:
                     continue
                 q = qual_name(node.func)
                 bad: Optional[str] = None
+                file_io = False
                 if q in _G001_CALLS:
                     bad = q
+                elif q in _G001_FILE_CALLS:
+                    bad, file_io = f"{q}()", True
                 elif q.split(".")[-1] == "block_until_ready":
                     bad = q or ".block_until_ready()"
                 elif (
@@ -122,7 +130,17 @@ def _g001(project: Project) -> Iterable[Finding]:
                     and not isinstance(node.args[0], ast.Constant)
                 ):
                     bad = f"{node.func.id}()"
-                if bad:
+                if bad and file_io:
+                    yield Finding(
+                        "G001", sf.path, node.lineno,
+                        f"blocking file syscall {bad} inside @hot_path "
+                        f"function '{fn.name}' — a per-tick storage "
+                        "stall",
+                        "file I/O belongs on the SSD tier's background "
+                        "writer (SsdStore._writer_loop) or in a non-hot "
+                        "helper, never inline on the dispatch thread",
+                    )
+                elif bad:
                     yield Finding(
                         "G001", sf.path, node.lineno,
                         f"device-sync primitive {bad} inside @hot_path "
@@ -132,10 +150,12 @@ def _g001(project: Project) -> Iterable[Finding]:
 
 
 register(Rule(
-    "G001", "hot-path device sync",
+    "G001", "hot-path device sync / blocking file I/O",
     "np.asarray / .item() / float()/bool() / block_until_ready / "
-    "jax.device_get inside a @hot_path serving function.",
-    "Dispatch, don't materialize: syncs belong on the resolver side.",
+    "jax.device_get, or a blocking file syscall (open / os.open / "
+    "os.fsync / mmap.mmap), inside a @hot_path serving function.",
+    "Dispatch, don't materialize: syncs belong on the resolver side, "
+    "file I/O on the SSD tier's background writer.",
     _g001,
 ))
 

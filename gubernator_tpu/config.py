@@ -121,6 +121,10 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_SNAPSHOT_DELTAS_PER_BASE": "delta records per base compaction",
     "GUBER_SNAPSHOT_DIR": "crash-safe snapshot directory ('' = off)",
     "GUBER_SNAPSHOT_INTERVAL": "delta snapshot cadence (seconds)",
+    "GUBER_SSD_CAPACITY_BYTES": "SSD-tier slab byte budget",
+    "GUBER_SSD_COMPACT_RATIO": "slab garbage fraction that triggers compaction",
+    "GUBER_SSD_DIR": "SSD-tier slab directory ('' = off)",
+    "GUBER_SSD_QUEUE_DEPTH": "SSD writer queue depth (demote batches)",
     "GUBER_STATUS_HTTP_ADDRESS": "no-mTLS health/metrics listener",
     "GUBER_TARGET_P99_MS": "AIMD limiter window-p99 target in ms (0 = off)",
     "GUBER_TICK_PIPELINE_DEPTH": "dispatched-unresolved tick windows in flight",
@@ -259,6 +263,15 @@ class Config:
     # from.  0 disables tiering (eviction destroys bucket state, the
     # reference's strict LRU semantics).  GUBER_COLD_CACHE_SIZE
     cold_cache_size: int = 0
+    # SSD third tier (docs/tiering.md): when GUBER_SSD_DIR names a
+    # directory, an append-only mmap slab store absorbs the cold tier's
+    # overflow — billions of keys under bounded RAM, with the SSD hop
+    # provably off the tick path.  Requires cold_cache_size > 0 (the
+    # SSD tier only ever holds cold-tier overflow).  Empty = off.
+    ssd_dir: str = ""
+    ssd_capacity_bytes: int = 1 << 30   # GUBER_SSD_CAPACITY_BYTES
+    ssd_compact_ratio: float = 0.5      # GUBER_SSD_COMPACT_RATIO
+    ssd_queue_depth: int = 8            # GUBER_SSD_QUEUE_DEPTH
     # GLOBAL reconciliation over the device mesh (collectives data plane,
     # parallel/global_mesh.py): N logical peer-nodes; 0 = gRPC loops only.
     # Node index -1 = auto (jax.process_index(), the multi-host identity).
@@ -573,6 +586,10 @@ def setup_daemon_config(
         fault_injector=FaultInjector.from_env(r),
         cache_size=r.int_("GUBER_CACHE_SIZE", 50_000),
         cold_cache_size=r.int_("GUBER_COLD_CACHE_SIZE", 0),
+        ssd_dir=r.str_("GUBER_SSD_DIR"),
+        ssd_capacity_bytes=r.int_("GUBER_SSD_CAPACITY_BYTES", 1 << 30),
+        ssd_compact_ratio=float(r.str_("GUBER_SSD_COMPACT_RATIO", "0.5")),
+        ssd_queue_depth=r.int_("GUBER_SSD_QUEUE_DEPTH", 8),
         snapshot_dir=r.str_("GUBER_SNAPSHOT_DIR"),
         snapshot_interval=r.float_seconds("GUBER_SNAPSHOT_INTERVAL", 5.0),
         snapshot_deltas_per_base=r.int_(
@@ -616,6 +633,25 @@ def setup_daemon_config(
     if conf.cold_cache_size < 0:
         raise ValueError(
             f"GUBER_COLD_CACHE_SIZE must be >= 0; got {conf.cold_cache_size}"
+        )
+    if conf.ssd_dir and conf.cold_cache_size <= 0:
+        raise ValueError(
+            "GUBER_SSD_DIR requires GUBER_COLD_CACHE_SIZE > 0: the SSD "
+            "tier only ever holds cold-tier overflow"
+        )
+    if conf.ssd_capacity_bytes <= 0:
+        raise ValueError(
+            f"GUBER_SSD_CAPACITY_BYTES must be > 0; "
+            f"got {conf.ssd_capacity_bytes}"
+        )
+    if not 0.0 < conf.ssd_compact_ratio <= 1.0:
+        raise ValueError(
+            f"GUBER_SSD_COMPACT_RATIO must be in (0, 1]; "
+            f"got {conf.ssd_compact_ratio}"
+        )
+    if conf.ssd_queue_depth < 1:
+        raise ValueError(
+            f"GUBER_SSD_QUEUE_DEPTH must be >= 1; got {conf.ssd_queue_depth}"
         )
     if conf.snapshot_interval <= 0:
         raise ValueError(

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
+import time
 from typing import Awaitable, Callable, Optional
 
 log = logging.getLogger("gubernator.resilience")
@@ -58,3 +60,45 @@ def spawn_supervised(
                 delay = min(delay * 2, max_delay)
 
     return asyncio.create_task(run(), name=name)
+
+
+def spawn_supervised_thread(
+    target: Callable[[], None],
+    *,
+    name: str,
+    should_restart: Callable[[], bool] = lambda: True,
+    metrics=None,
+    loop_label: Optional[str] = None,
+    restart_delay: float = 0.01,
+    max_delay: float = 1.0,
+) -> threading.Thread:
+    """Thread twin of :func:`spawn_supervised` for loops that must run
+    off the event loop entirely (blocking file I/O: the SSD tier's slab
+    writer).  Same contract: restart on crash with a doubling delay,
+    gone only on clean return, ``should_restart()`` False, or process
+    exit (the thread is a daemon).
+    """
+
+    def run() -> None:
+        delay = restart_delay
+        while True:
+            try:
+                target()
+                return  # clean exit
+            except Exception:
+                if not should_restart():
+                    return
+                log.exception(
+                    "background thread %r crashed; restarting in %.3fs",
+                    name, delay,
+                )
+                if metrics is not None:
+                    metrics.loop_restarts.labels(
+                        loop=loop_label or name
+                    ).inc()
+                time.sleep(delay)
+                delay = min(delay * 2, max_delay)
+
+    thread = threading.Thread(target=run, name=name, daemon=True)
+    thread.start()
+    return thread

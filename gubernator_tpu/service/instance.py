@@ -84,6 +84,12 @@ class InstanceConfig:
     tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
     tpu_bg_reclaim: str = "auto"         # background reclamation (engine.py)
     cold_cache_size: int = 0             # tiered cold store (docs/tiering.md)
+    # SSD third tier (docs/tiering.md): slab directory (empty = off),
+    # byte budget, compaction threshold, writer queue depth.
+    ssd_dir: str = ""
+    ssd_capacity_bytes: int = 1 << 30
+    ssd_compact_ratio: float = 0.5
+    ssd_queue_depth: int = 8
     # Crash-safe persistence (docs/persistence.md): snapshot directory
     # (empty = off), delta-flush cadence, compaction threshold, and the
     # graceful-drain budget for GlobalManager.close.
@@ -128,6 +134,10 @@ class InstanceConfig:
             tpu_table_layout=conf.tpu_table_layout,
             tpu_bg_reclaim=conf.tpu_bg_reclaim,
             cold_cache_size=conf.cold_cache_size,
+            ssd_dir=conf.ssd_dir,
+            ssd_capacity_bytes=conf.ssd_capacity_bytes,
+            ssd_compact_ratio=conf.ssd_compact_ratio,
+            ssd_queue_depth=conf.ssd_queue_depth,
             snapshot_dir=conf.snapshot_dir,
             snapshot_interval=conf.snapshot_interval,
             snapshot_deltas_per_base=conf.snapshot_deltas_per_base,
@@ -157,6 +167,11 @@ def _make_engine(conf: InstanceConfig):
                 "GUBER_COLD_CACHE_SIZE is not supported by the sharded "
                 "mesh engine yet; tiering disabled"
             )
+        if conf.ssd_dir:
+            log.warning(
+                "GUBER_SSD_DIR is not supported by the sharded mesh "
+                "engine yet; SSD tier disabled"
+            )
         devices = jax.devices()[: conf.tpu_mesh_shards]
         local_cap = max(1, conf.cache_size // len(devices))
         return MeshTickEngine(
@@ -171,6 +186,17 @@ def _make_engine(conf: InstanceConfig):
     from gubernator_tpu.ops.engine import TickEngine
 
     bg = {"auto": None, "on": True, "off": False}[conf.tpu_bg_reclaim]
+    ssd = None
+    if conf.ssd_dir and conf.cold_cache_size > 0:
+        from gubernator_tpu.tiering import SsdStore
+
+        ssd = SsdStore(
+            conf.ssd_dir,
+            capacity_bytes=conf.ssd_capacity_bytes,
+            compact_ratio=conf.ssd_compact_ratio,
+            queue_depth=conf.ssd_queue_depth,
+            metrics=conf.metrics,
+        )
     return TickEngine(
         capacity=conf.cache_size,
         max_batch=conf.tpu_max_batch,
@@ -178,6 +204,7 @@ def _make_engine(conf: InstanceConfig):
         table_layout=conf.tpu_table_layout,
         bg_reclaim=bg,
         cold_capacity=conf.cold_cache_size,
+        ssd=ssd,
     )
 
 

@@ -135,6 +135,10 @@ class TickLoop:
         self._synced_cold_hits = 0
         self._synced_promotions = 0
         self._synced_demotions = 0
+        self._synced_ssd_hits = 0
+        self._synced_ssd_promotions = 0
+        self._synced_ssd_demotions = 0
+        self._synced_ssd_compactions = 0
         self._synced_shed = 0
         self._synced_routed = 0
         self._synced_routed_overflows = 0
@@ -545,6 +549,28 @@ class TickLoop:
                 m.cold_demotions.inc(demos - self._synced_demotions)
                 self._synced_demotions = demos
             m.cold_size.set(len(cold))
+        # SSD tier families: counters as deltas from the slab store's
+        # plain-int mirrors; bytes/queue depth are levels, set directly.
+        ssd = getattr(self.engine, "ssd", None)
+        if ssd is not None:
+            ssd_hits = getattr(self.engine, "metric_ssd_hits", 0)
+            if ssd_hits > self._synced_ssd_hits:
+                m.ssd_hits.inc(ssd_hits - self._synced_ssd_hits)
+                self._synced_ssd_hits = ssd_hits
+            if ssd.metric_promotions > self._synced_ssd_promotions:
+                m.ssd_promotions.inc(
+                    ssd.metric_promotions - self._synced_ssd_promotions)
+                self._synced_ssd_promotions = ssd.metric_promotions
+            if ssd.metric_demotions > self._synced_ssd_demotions:
+                m.ssd_demotions.inc(
+                    ssd.metric_demotions - self._synced_ssd_demotions)
+                self._synced_ssd_demotions = ssd.metric_demotions
+            if ssd.metric_compactions > self._synced_ssd_compactions:
+                m.ssd_compactions.inc(
+                    ssd.metric_compactions - self._synced_ssd_compactions)
+                self._synced_ssd_compactions = ssd.metric_compactions
+            m.ssd_bytes.set(ssd.bytes_used())
+            m.ssd_queue_depth.set(ssd.queue_depth())
         if hasattr(self.engine, "hot_occupancy"):
             m.hot_occupancy.set(self.engine.hot_occupancy())
         if hasattr(self.engine, "h2d_overlap_ratio"):

@@ -41,7 +41,17 @@ class Store(Protocol):
     ``req`` is None because no request drove the flush — so a third
     durability tier can absorb what the host tier drops.  ``remove`` is
     fired when an item leaves the tiered cache entirely: hot-tier
-    eviction with no cold tier configured, or cold-tier TTL expiry."""
+    eviction with no cold tier configured, or cold-tier TTL expiry.
+
+    **Batched extension (optional).**  A store may additionally expose
+    ``put_batch(items)`` / ``remove_batch(keys)``; tier dispatchers
+    (``ColdStore._flush_shed`` / ``_sink_remove``) feature-detect them
+    with ``hasattr`` and fall back to the per-item ``on_change`` /
+    ``remove`` loop, so one cold-tier evict sweep costs one sink call
+    instead of one Python call per key.  The SSD tier
+    (:class:`~gubernator_tpu.tiering.ssd.SsdStore`) implements both,
+    plus the columnar ``put_columns(keys, cols, now)`` fast path that
+    skips dict materialization entirely."""
 
     def on_change(self, req: Optional[RateLimitRequest], item: dict) -> None:
         """Called after every mutation with the full bucket state (and
@@ -52,6 +62,18 @@ class Store(Protocol):
 
     def remove(self, key: str) -> None:
         """Called when an item is evicted from the cache."""
+
+
+class BatchStore(Store, Protocol):
+    """A Store that also accepts batched writes/removals (see the
+    batched-extension note on :class:`Store` — detection is by
+    ``hasattr``, this Protocol just names the contract)."""
+
+    def put_batch(self, items: List[dict]) -> None:
+        """Absorb one write-behind sweep's items in a single call."""
+
+    def remove_batch(self, keys: List[str]) -> None:
+        """Drop a batch of keys in a single call."""
 
 
 class Loader(Protocol):

@@ -6,9 +6,12 @@ any key cycling out and back in restarted with a full budget — a
 rate-limit bypass under churn.  The cold tier is a bounded host-side
 columnar store the engine demotes victims into (readback-then-evict)
 and promotes misses out of (one batched restore scatter per tick), so
-bucket continuity survives hot↔cold cycling.  See docs/tiering.md.
+bucket continuity survives hot↔cold cycling.  Below it, the SSD tier
+(ssd.py) absorbs the cold store's overflow into append-only mmap slab
+files — billions of keys under bounded RAM.  See docs/tiering.md.
 """
 
 from gubernator_tpu.tiering.coldstore import ColdStore
+from gubernator_tpu.tiering.ssd import SsdStore
 
-__all__ = ["ColdStore"]
+__all__ = ["ColdStore", "SsdStore"]

@@ -116,22 +116,29 @@ class ColdStore:
             self._dirty.discard(i)
             self._free.append(i)
 
-    def _item(self, i: int) -> dict:
+    @staticmethod
+    def _cols_item(keys: List[bytes], cols: Dict[str, np.ndarray],
+                   j: int) -> dict:
         return {
-            "key": self._keys[i].decode(),
+            "key": keys[j].decode(),
             **{
-                f: (float if f == "remaining_f" else int)(self._cols[f][i])
+                f: (float if f == "remaining_f" else int)(cols[f][j])
                 for f in COLD_FIELDS
             },
         }
 
-    def _evict_overflow(self, want: int) -> None:
+    def _evict_overflow(
+        self, want: int
+    ) -> Tuple[List[bytes], Dict[str, np.ndarray]]:
         """Free ``want`` entries by the cold tier's own LRU (oldest touch
-        clock), optionally write-behind to the Store sink."""
+        clock).  Returns the victims as ``(keys, cols)`` copies when a
+        write-behind sink is wired — the CALLER ships them to the sink
+        after releasing ``self._lock``: sink I/O under the lock stalls
+        every concurrent promote behind the sink's disk."""
         used = np.flatnonzero(self._used)
         n = min(want, len(used))
         if n <= 0:
-            return
+            return [], {}
         if n >= len(used):
             victims = used
         else:
@@ -139,11 +146,54 @@ class ColdStore:
             # entries and overflow eviction rides the demote path.
             victims = used[np.argpartition(self._touch[used], n - 1)[:n]]
         self.metric_overflow_evictions += len(victims)
+        keys: List[bytes] = []
+        cols: Dict[str, np.ndarray] = {}
         if self.store is not None:
-            for i in victims:
-                self.store.on_change(None, self._item(int(i)))
-            self.metric_write_behind += len(victims)
+            keys = [self._keys[int(i)] for i in victims]
+            cols = {f: self._cols[f][victims].copy() for f in COLD_FIELDS}
         self._release(victims)
+        return keys, cols
+
+    # ------------------------------------------------------------------
+    # Write-behind sink dispatch (always OUTSIDE self._lock)
+    # ------------------------------------------------------------------
+    def _flush_shed(
+        self,
+        shed: List[Tuple[List[bytes], Dict[str, np.ndarray]]],
+        now: int,
+    ) -> None:
+        """Ship overflow victims to the sink, one batched call per evict
+        sweep: columnar ``put_columns`` (the SSD tier) > ``put_batch``
+        (batched Store) > per-item ``on_change`` fallback."""
+        if self.store is None:
+            return
+        for keys, cols in shed:
+            if not keys:
+                continue
+            if hasattr(self.store, "put_columns"):
+                self.store.put_columns(keys, cols, now)
+            elif hasattr(self.store, "put_batch"):
+                self.store.put_batch([
+                    self._cols_item(keys, cols, j)
+                    for j in range(len(keys))
+                ])
+            else:
+                for j in range(len(keys)):
+                    self.store.on_change(
+                        None, self._cols_item(keys, cols, j)
+                    )
+            self.metric_write_behind += len(keys)
+
+    def _sink_remove(self, keys: List[str]) -> None:
+        """TTL-dropped keys leave the tiered cache entirely: batched
+        sink removal (``remove_batch`` > per-key ``remove``)."""
+        if self.store is None or not keys:
+            return
+        if hasattr(self.store, "remove_batch"):
+            self.store.remove_batch(keys)
+        else:
+            for key in keys:
+                self.store.remove(key)
 
     # ------------------------------------------------------------------
     # Demote (device → cold)
@@ -161,6 +211,7 @@ class ColdStore:
             return 0
         expire = np.asarray(cols["expire_at"], np.int64)
         keep = expire >= now
+        shed: List[Tuple[List[bytes], Dict[str, np.ndarray]]] = []
         with self._lock:
             self._clock += 1
             idx = np.empty(len(keys), np.int64)
@@ -178,7 +229,7 @@ class ColdStore:
             if n_new:
                 shortfall = len(self._map) + n_new - self.capacity
                 if shortfall > 0:
-                    self._evict_overflow(shortfall)
+                    shed.append(self._evict_overflow(shortfall))
                 self._grow(len(self._map) + n_new)
                 for j, key in enumerate(keys):
                     if idx[j] != -2:
@@ -192,21 +243,22 @@ class ColdStore:
                     self._used[i] = True
                     idx[j] = i
             sel = np.flatnonzero(idx >= 0)
-            if len(sel) == 0:
-                return 0
-            dst = idx[sel]
-            for f in COLD_FIELDS:
-                self._cols[f][dst] = np.asarray(cols[f])[sel]
-            self._touch[dst] = self._clock
-            self._dirty.update(int(i) for i in dst)
-            self.metric_demotions += len(sel)
-            # One demote batch can exceed the whole budget (a big reclaim
-            # into a small tier): enforce it after the writes too, so the
-            # excess write-behinds instead of silently over-filling.
-            over = len(self._map) - self.capacity
-            if over > 0:
-                self._evict_overflow(over)
-            return len(sel)
+            if len(sel) > 0:
+                dst = idx[sel]
+                for f in COLD_FIELDS:
+                    self._cols[f][dst] = np.asarray(cols[f])[sel]
+                self._touch[dst] = self._clock
+                self._dirty.update(int(i) for i in dst)
+                self.metric_demotions += len(sel)
+                # One demote batch can exceed the whole budget (a big
+                # reclaim into a small tier): enforce it after the writes
+                # too, so the excess write-behinds instead of silently
+                # over-filling.
+                over = len(self._map) - self.capacity
+                if over > 0:
+                    shed.append(self._evict_overflow(over))
+        self._flush_shed(shed, now)
+        return len(sel)
 
     # ------------------------------------------------------------------
     # Promote (cold → device)
@@ -224,6 +276,7 @@ class ColdStore:
         are dropped."""
         if not keys:
             return np.empty(0, np.int64), {}
+        removed: List[str] = []
         with self._lock:
             self._clock += 1
             pos: List[int] = []
@@ -244,17 +297,19 @@ class ColdStore:
             if expired:
                 exp = np.asarray(expired, np.int64)
                 if self.store is not None:
-                    for i in exp:
-                        self.store.remove(self._keys[int(i)].decode())
+                    removed = [self._keys[int(i)].decode() for i in exp]
                 self._release(exp)
             if not idx:
-                return np.empty(0, np.int64), {}
-            src = np.asarray(idx, np.int64)
-            out = {f: self._cols[f][src].copy() for f in COLD_FIELDS}
-            self._release(src)
-            self.metric_hits += len(idx)
-            self.metric_promotions += len(idx)
-            return np.asarray(pos, np.int64), out
+                out_pos, out = np.empty(0, np.int64), {}
+            else:
+                src = np.asarray(idx, np.int64)
+                out = {f: self._cols[f][src].copy() for f in COLD_FIELDS}
+                self._release(src)
+                self.metric_hits += len(idx)
+                self.metric_promotions += len(idx)
+                out_pos = np.asarray(pos, np.int64)
+        self._sink_remove(removed)
+        return out_pos, out
 
     # ------------------------------------------------------------------
     # Maintenance
@@ -264,6 +319,7 @@ class ColdStore:
         passed.  Cheap enough to ride the engine's reclaim cadence (one
         compare over the used columns, no per-key work until the rare
         release)."""
+        removed: List[str] = []
         with self._lock:
             if self._alloc == 0:
                 return 0
@@ -272,10 +328,10 @@ class ColdStore:
                 return 0
             self.metric_expired += len(dead)
             if self.store is not None:
-                for i in dead:
-                    self.store.remove(self._keys[int(i)].decode())
+                removed = [self._keys[int(i)].decode() for i in dead]
             self._release(dead)
-            return len(dead)
+        self._sink_remove(removed)
+        return len(dead)
 
     def export_columns(
         self, dirty_only: bool = False

@@ -18,7 +18,10 @@ Stage semantics:
   via ``edge()``; decode time accumulates and folds into the *next*
   window begun, encode attaches to the most recently finished window
   (a window's decode is the CPU that fed it; its encode trails it).
-- ``pack`` includes the arena ``lease`` (also broken out separately).
+- ``pack`` includes the arena ``lease`` (also broken out separately);
+  ``ssd`` is the miss path's batched slab-store lookup, broken OUT of
+  ``pack`` (the engine subtracts it), so a pack regression can't hide
+  SSD I/O and vice versa.
 - ``tick`` is the shared D2H wait of the resolver drain that resolved
   the window; windows resolved in one drain report the same tick time.
 
@@ -40,7 +43,9 @@ import numpy as np
 
 from gubernator_tpu.utils.hotpath import hot_path
 
-STAGES = ("decode", "lease", "pack", "h2d", "tick", "resolve", "encode")
+STAGES = (
+    "decode", "lease", "pack", "ssd", "h2d", "tick", "resolve", "encode",
+)
 _IDX = {s: i for i, s in enumerate(STAGES)}
 _DECODE = _IDX["decode"]
 _ENCODE = _IDX["encode"]

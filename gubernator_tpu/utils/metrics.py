@@ -397,6 +397,43 @@ class Metrics:
             "The number of entries currently held by the cold store.",
             registry=reg,
         )
+        # SSD third tier (docs/tiering.md): demote/promote traffic
+        # between the cold store and the slab store, slab occupancy in
+        # bytes, compaction rounds, and the writer queue level.
+        self.ssd_demotions = Counter(
+            "gubernator_tpu_ssd_demotions",
+            "Bucket rows demoted from the cold store into the SSD slab "
+            "store (batched write-behind on cold-tier overflow).",
+            registry=reg,
+        )
+        self.ssd_promotions = Counter(
+            "gubernator_tpu_ssd_promotions",
+            "Bucket rows promoted from the SSD slab store back up the "
+            "tiers (one batched lookup per miss tick).",
+            registry=reg,
+        )
+        self.ssd_hits = Counter(
+            "gubernator_tpu_ssd_hits",
+            "Miss-path SSD lookups that found their bucket in a slab.",
+            registry=reg,
+        )
+        self.ssd_compactions = Counter(
+            "gubernator_tpu_ssd_compactions",
+            "Log-structured compaction rounds (a sealed slab's live "
+            "rows rewritten forward, the file retired).",
+            registry=reg,
+        )
+        self.ssd_bytes = Gauge(
+            "gubernator_tpu_ssd_bytes",
+            "Bytes currently held across SSD slab files.",
+            registry=reg,
+        )
+        self.ssd_queue_depth = Gauge(
+            "gubernator_tpu_ssd_queue_depth",
+            "Demote batches waiting on the SSD writer queue (at the "
+            "configured depth, demote sweeps block — backpressure).",
+            registry=reg,
+        )
         self.hot_occupancy = Gauge(
             "gubernator_tpu_hot_occupancy",
             "Fraction of device bucket-table slots holding a mapped key "

@@ -69,6 +69,16 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   lease_dispatch_per_window      1.0 — lease grant/sync accounting is
 #                                        ONE batched column scatter per
 #                                        window, never per-key dispatch
+#   ssd_continuity_errors          0   — keys promoted back from the SSD
+#                                        slab tier keep their consumed
+#                                        budget (the cold-tier invariant,
+#                                        one level down on flash)
+#   ssd_tick_path_reads            0   — slab lookups never run inside
+#                                        the tick-dispatch block (SSD I/O
+#                                        stays out of tick/pack stages)
+#   ssd_promote_batches_per_miss_tick 1.0 — the miss path's third hop is
+#                                        ONE batched slab lookup per miss
+#                                        tick, never per-key reads
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -84,6 +94,9 @@ COUNT_KEYS = (
     "lease_over_admission",
     "lease_bucket_drift",
     "lease_dispatch_per_window",
+    "ssd_continuity_errors",
+    "ssd_tick_path_reads",
+    "ssd_promote_batches_per_miss_tick",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -177,6 +190,13 @@ ABSOLUTE_MAX_KEYS = {
     # scatter per grant/sync window, exactly — a candidate above 1.0
     # re-introduced per-key dispatch (docs/leases.md).
     "lease_dispatch_per_window": 1.0,
+    # The SSD miss hop is ONE batched slab lookup per miss tick — above
+    # 1.0 the tier re-introduced per-key reads (docs/tiering.md).
+    "ssd_promote_batches_per_miss_tick": 1.0,
+    # The SSD churn rung's 8x working set lives on flash: resident-set
+    # growth across the rung stays bounded by the two RAM tiers no
+    # matter what the baseline measured.
+    "churn_ssd_rss_mb": 512,
 }
 
 GATED_VALUE_KEYS = (
@@ -187,7 +207,7 @@ GATED_VALUE_KEYS = (
 # Keys gated ONLY by their absolute bound above, never baseline-relative:
 # a 1 MB -> 3 MB RSS wiggle is allocator noise, not a 3x regression, so
 # a relative comparison on a near-zero base would flap forever.
-ABSOLUTE_ONLY_KEYS = ("overload_rss_growth_mb",)
+ABSOLUTE_ONLY_KEYS = ("overload_rss_growth_mb", "churn_ssd_rss_mb")
 
 # Keys gated at exactly 0 in the CANDIDATE even when the baseline lacks
 # the rung: each is an absolute correctness invariant, not a relative
@@ -203,6 +223,8 @@ ABSOLUTE_ZERO_KEYS = (
     "expired_served",
     "lease_over_admission",
     "lease_bucket_drift",
+    "ssd_continuity_errors",
+    "ssd_tick_path_reads",
 )
 
 

@@ -98,6 +98,31 @@ def test_g001_flags_sync_primitives_in_hot_path(tmp_path):
         assert tok in msgs
 
 
+def test_g001_flags_blocking_file_syscalls_in_hot_path(tmp_path):
+    src = """\
+    import os
+    import mmap
+    from pkg.utils.hotpath import hot_path
+
+    @hot_path
+    def take_batch(self, path):
+        f = open(path, "rb")              # storage stall
+        fd = os.open(path, os.O_RDONLY)   # storage stall
+        os.fsync(fd)                      # storage stall
+        m = mmap.mmap(fd, 0)              # storage stall
+        return f, m
+
+    def writer_loop(path):
+        return open(path, "ab")           # unmarked: fine
+    """
+    out = findings(tmp_path, {"mod.py": src}, "G001")
+    assert len(out) == 4
+    msgs = " ".join(f.message for f in out)
+    for tok in ("open()", "os.open()", "os.fsync()", "mmap.mmap()"):
+        assert tok in msgs
+    assert "blocking file syscall" in out[0].message
+
+
 def test_g001_ignores_unmarked_and_nested_and_jnp(tmp_path):
     src = """\
     import numpy as np
@@ -520,6 +545,10 @@ def test_repo_hot_path_markers_present():
         # Telemetry plane (docs/observability.md): the flight recorder's
         # record path runs inside every instrumented serving window.
         "gubernator_tpu/utils/flightrec.py": ["begin", "note", "finish"],
+        # SSD tier (docs/tiering.md): demote staging and the miss-path
+        # batched lookup run on the dispatch thread — the file-syscall
+        # arm of G001 keeps slab I/O on the background writer.
+        "gubernator_tpu/tiering/ssd.py": ["put_columns", "take_batch"],
     }
     for path, names in expected.items():
         text = proj.by_path[path].text

@@ -8,6 +8,9 @@ blind-zeroing reclaim gave every returning key a fresh bucket, a
 rate-limit bypass any key-churning client could exploit.
 """
 
+import threading
+import time
+
 import numpy as np
 
 from gubernator_tpu.ops.engine import TickEngine
@@ -202,6 +205,81 @@ def test_cold_ttl_expiry():
     assert cold.expire(NOW + 100) == 0
     assert cold.expire(NOW + 20_000) == 1
     assert len(cold) == 0
+
+
+def _cols(n, expire):
+    cols = {
+        f: np.arange(n, dtype=np.float64 if f == "remaining_f" else np.int64)
+        for f in ("algorithm", "limit", "remaining", "remaining_f",
+                  "duration", "created_at", "updated_at", "burst", "status")
+    }
+    cols["expire_at"] = np.full(n, expire, np.int64)
+    return cols
+
+
+def test_slow_sink_never_blocks_concurrent_take():
+    # Regression: overflow write-behind used to run INSIDE the cold
+    # store's lock, so a slow sink (network store, SSD under fsync)
+    # stalled every concurrent reader.  Sink calls now happen after the
+    # lock is released.
+    class SlowSink:
+        def __init__(self):
+            self.entered = threading.Event()
+
+        def put_columns(self, keys, cols, now):
+            self.entered.set()
+            time.sleep(0.5)
+
+    sink = SlowSink()
+    cold = ColdStore(capacity=4, store=sink)
+    cold.put_columns([f"a{i}".encode() for i in range(4)],
+                     _cols(4, NOW + 10_000), NOW)
+    t = threading.Thread(
+        target=cold.put_columns,
+        args=([f"b{i}".encode() for i in range(4)],
+              _cols(4, NOW + 10_000), NOW),
+    )
+    t.start()
+    assert sink.entered.wait(5.0)  # overflow shed is inside the sink now
+    t0 = time.monotonic()
+    pos, _ = cold.take([b"b0"], NOW)
+    elapsed = time.monotonic() - t0
+    t.join(5.0)
+    assert len(pos) == 1
+    assert elapsed < 0.25, (
+        f"take blocked {elapsed:.2f}s behind a slow sink — sink calls "
+        "must run outside the cold store's lock"
+    )
+
+
+def test_cold_overflow_prefers_batched_sink():
+    # A sink advertising put_batch/remove_batch gets ONE call per shed
+    # sweep / expiry sweep, not one per item.
+    class BatchSink:
+        def __init__(self):
+            self.put_calls = []
+            self.remove_calls = []
+
+        def put_batch(self, items):
+            self.put_calls.append(items)
+
+        def remove_batch(self, keys):
+            self.remove_calls.append(keys)
+
+    sink = BatchSink()
+    cold = ColdStore(capacity=4, store=sink)
+    put = cold.put_columns([f"w{i}".encode() for i in range(6)],
+                           _cols(6, NOW + 10_000), NOW)
+    assert put == 6
+    assert len(sink.put_calls) == 1  # one batched call for both victims
+    assert len(sink.put_calls[0]) == 2
+    assert cold.metric_overflow_evictions == 2
+    # Expiry sweep batches removals the same way.
+    cols = _cols(2, NOW + 50)
+    cold.put_columns([b"s0", b"s1"], cols, NOW)
+    assert cold.expire(NOW + 100) == 2
+    assert len(sink.remove_calls) == 1  # one batched removal call
+    assert sorted(sink.remove_calls[0]) == ["s0", "s1"]
 
 
 def test_cold_put_drops_already_expired_rows():
