@@ -1610,6 +1610,132 @@ def rung_serve_loopback(engine, n_keys):
 
 
 # ----------------------------------------------------------------------
+# Multi-process edge serving rung (docs/edge.md)
+# ----------------------------------------------------------------------
+def rung_serve_multiproc():
+    """Served throughput through the shared-memory edge plane: N worker
+    PROCESSES decode fastwire frames into shm slab rings concurrently
+    (no GIL between them) while the owner drains every ring into one
+    tick loop — the serving path whose decode ceiling the loopback rung
+    measures one process at a time.
+
+    Exact-work invariants, all gated at ABSOLUTE ZERO
+    (scripts/check_bench_regression.py):
+
+    * ``multiproc_parity_errors`` — after the drive drains, a zero-hit
+      probe of every key reads the engine's applied hits; the total
+      must equal the sum of worker-acked hits (each worker drives a
+      disjoint keyspace, so the split is exact).
+    * ``multiproc_double_served`` — responses for windows not pending
+      (served twice or never published).
+    * ``multiproc_dropped_acked`` — published windows that never came
+      back.
+    """
+    from gubernator_tpu.edge.plane import EdgeConfig, EdgePlane
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.ops.reqcols import (
+        CREATED_UNSET, ReqColumns, key_blob_from_parts,
+    )
+    from gubernator_tpu.service.tickloop import TickLoop
+    from gubernator_tpu.transport import fastwire
+    from gubernator_tpu.utils import flightrec
+
+    if fastwire.load() is None:
+        return {"rung": "serve_multiproc", "skipped": "no native codec"}
+    workers = 2 if FAST else 4
+    batch = 1000                      # the public API batch cap
+    windows = 100 if FAST else 2500   # per worker
+    n_keys = 4096                     # per worker, disjoint by prefix
+    limit = 1 << 40
+    duration = 3_600_000
+    engine = TickEngine(capacity=1 << 16, max_batch=4096)
+    loop = TickLoop(engine, batch_limit=4096)
+    plane = EdgePlane(loop, EdgeConfig(
+        workers=workers, slabs=8, ring_depth=16, max_batch=batch,
+        mode="drive",
+        drive={
+            "batch": batch, "windows": windows, "keys": n_keys,
+            "hits": 1, "limit": limit, "duration": duration, "frames": 8,
+        },
+    ))
+    rec = flightrec.FlightRecorder(windows=512)
+    prev_rec = flightrec.get()
+    flightrec.install(rec)
+    try:
+        plane.start()
+        if not plane.wait_ready(60):
+            raise RuntimeError("edge workers never became ready")
+        t0 = time.perf_counter()
+        plane.go()
+        if not plane.wait_drive_done(600):
+            raise RuntimeError("edge drive did not complete")
+        elapsed = time.perf_counter() - t0
+        # Counter snapshot BEFORE close: teardown unmaps the shm views
+        # the counter block lives in.
+        tot = plane.totals()
+        plane.close()
+        stage_pcts = rec.stage_percentiles()
+    finally:
+        if prev_rec is not None:
+            flightrec.install(prev_rec)
+        else:
+            flightrec.uninstall()
+
+    # Zero-hit probe: read back every bucket's remaining and compare the
+    # engine-applied total against the workers' acked-hit accounting.
+    consumed = 0
+    for wid in range(workers):
+        for at in range(0, n_keys, batch):
+            keys = [f"w{wid}_{k}" for k in range(at, min(at + batch, n_keys))]
+            n = len(keys)
+            blob, off = key_blob_from_parts(["edge"] * n, keys)
+            z = np.zeros(n, np.int64)
+            cols = ReqColumns(
+                blob, off, z, np.full(n, limit, np.int64),
+                np.full(n, duration, np.int64), z, z,
+                np.full(n, CREATED_UNSET, np.int64), z,
+                name_len=np.full(n, 4, np.int64),
+            )
+            mat, errs = loop.submit_columns(cols).result(timeout=60)
+            if errs:
+                raise RuntimeError(f"probe errors: {errs}")
+            consumed += int((limit - mat[2]).sum())
+    loop.close()
+    engine.close()
+
+    rate = tot["rows_acked"] / max(elapsed, 1e-9)
+    out = {
+        "rung": "serve_multiproc",
+        "workers": workers,
+        "batch": batch,
+        "windows_per_worker": windows,
+        "measured": True,
+        "decisions_per_sec": round(rate, 1),
+        "elapsed_s": round(elapsed, 3),
+        "vs_5m_served_target": round(rate / 5e6, 4),
+        "windows_published": int(tot["windows_published"]),
+        "windows_acked": int(tot["windows_acked"]),
+        "hits_published": int(tot["hits_published"]),
+        "hits_acked": int(tot["hits_acked"]),
+        "engine_applied_hits": consumed,
+        "decode_seconds_total": round(tot["decode_seconds"], 4),
+        "backpressure_waits": int(tot["backpressure_waits"]),
+        "worker_restarts": int(tot["restarts"]),
+        # -- ABSOLUTE_ZERO-gated exact-work counters --
+        "multiproc_parity_errors": abs(consumed - int(tot["hits_acked"])),
+        "multiproc_double_served": int(tot["double_served"]),
+        "multiproc_dropped_acked": int(
+            tot["windows_published"] - tot["windows_acked"]
+        ),
+    }
+    for s in ("decode", "pack", "h2d", "tick", "encode"):
+        pct = stage_pcts.get(s, {})
+        out[f"stage_{s}_p50_ms"] = pct.get("p50_ms", 0.0)
+        out[f"stage_{s}_p99_ms"] = pct.get("p99_ms", 0.0)
+    return out
+
+
+# ----------------------------------------------------------------------
 # Chaos rung: partition the GLOBAL owner, then prove zero hit loss
 # ----------------------------------------------------------------------
 async def _chaos_bench():
@@ -2791,6 +2917,11 @@ def main():
             big_engine.close()  # idempotent; covers a failed rung
         del big_engine
     state.clear()
+
+    # Multi-process edge serving: own (small) engine, placed after the
+    # 10M engines are released so the worker fleet never competes with
+    # a prefill for host cores.
+    ladder.append(_safe("serve_multiproc", rung_serve_multiproc))
 
     if not FAST:
         # Top of the ladder: needs 8 GB HBM free — runs after the 10M

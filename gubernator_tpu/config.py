@@ -62,6 +62,9 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_DISABLE_BATCHING": "disable peer-forwarding batches",
     "GUBER_DNS_FQDN": "dns discovery: name to resolve for peers",
     "GUBER_DRAIN_TIMEOUT": "graceful-shutdown GLOBAL flush budget",
+    "GUBER_EDGE_RING_DEPTH": "edge plane: response slots per worker",
+    "GUBER_EDGE_SHM_SLABS": "edge plane: request slabs per worker",
+    "GUBER_EDGE_WORKERS": "edge decode worker processes (0 = off)",
     "GUBER_ETCD_DIAL_TIMEOUT": "etcd discovery: dial timeout",
     "GUBER_ETCD_ENDPOINTS": "etcd discovery: endpoints (comma list)",
     "GUBER_ETCD_KEY_PREFIX": "etcd discovery: peer key prefix",
@@ -293,6 +296,15 @@ class Config:
     # hit/broadcast/redelivery flush inside GlobalManager.close so a
     # dead peer can't wedge shutdown.  GUBER_DRAIN_TIMEOUT
     drain_timeout: float = 2.0
+
+    # Multi-process streaming edge (docs/edge.md): N decode worker
+    # processes feeding the tick loop through shared-memory slab rings.
+    # 0 keeps the in-process serving path byte-identical and never
+    # creates a shm segment.  GUBER_EDGE_WORKERS /
+    # GUBER_EDGE_SHM_SLABS / GUBER_EDGE_RING_DEPTH
+    edge_workers: int = 0
+    edge_shm_slabs: int = 8
+    edge_ring_depth: int = 16
 
     # Fault-tolerant peer path (docs/resilience.md): per-peer circuit
     # breakers, forward-retry backoff, and the GLOBAL redelivery buffer.
@@ -596,6 +608,9 @@ def setup_daemon_config(
             "GUBER_SNAPSHOT_DELTAS_PER_BASE", 64
         ),
         drain_timeout=r.float_seconds("GUBER_DRAIN_TIMEOUT", 2.0),
+        edge_workers=r.int_("GUBER_EDGE_WORKERS", 0),
+        edge_shm_slabs=r.int_("GUBER_EDGE_SHM_SLABS", 8),
+        edge_ring_depth=r.int_("GUBER_EDGE_RING_DEPTH", 16),
         data_center=r.str_("GUBER_DATA_CENTER"),
         local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
@@ -666,6 +681,18 @@ def setup_daemon_config(
     if conf.drain_timeout < 0:
         raise ValueError(
             f"GUBER_DRAIN_TIMEOUT must be >= 0; got {conf.drain_timeout}"
+        )
+    if conf.edge_workers < 0:
+        raise ValueError(
+            f"GUBER_EDGE_WORKERS must be >= 0; got {conf.edge_workers}"
+        )
+    if conf.edge_shm_slabs < 1:
+        raise ValueError(
+            f"GUBER_EDGE_SHM_SLABS must be >= 1; got {conf.edge_shm_slabs}"
+        )
+    if conf.edge_ring_depth < 1:
+        raise ValueError(
+            f"GUBER_EDGE_RING_DEPTH must be >= 1; got {conf.edge_ring_depth}"
         )
     if not 0.0 < resilience.breaker_failure_threshold <= 1.0:
         raise ValueError(

@@ -111,7 +111,23 @@ def load_library() -> Optional[ctypes.CDLL]:
     return lib
 
 
-def crc32_batch(blob: bytes, offsets: np.ndarray) -> np.ndarray:
+def as_char_p(blob):
+    """A ``c_char_p``-compatible view of any bytes-like blob, copy-free
+    for writable buffers (numpy views into a shared-memory slab, byte-
+    arrays).  The native calls index strictly by (blob, offsets), so the
+    missing NUL terminator of a raw buffer is irrelevant.  Read-only
+    non-bytes buffers (rare: memoryview of bytes) fall back to one copy."""
+    if isinstance(blob, (bytes, ctypes.Array)):
+        return blob
+    mv = memoryview(blob).cast("B")
+    if mv.readonly:
+        return mv.tobytes()
+    return ctypes.cast(
+        (ctypes.c_char * mv.nbytes).from_buffer(mv), ctypes.c_char_p
+    )
+
+
+def crc32_batch(blob, offsets: np.ndarray) -> np.ndarray:
     """zlib-compatible CRC-32 of every key in a packed (blob, offsets)
     pair — the mesh engine's vectorized key→shard router.  Falls back to
     a zlib loop when the native library is unavailable."""
@@ -127,7 +143,7 @@ def crc32_batch(blob: bytes, offsets: np.ndarray) -> np.ndarray:
         )
     offsets = np.ascontiguousarray(offsets, np.int64)
     out = np.empty(n, np.uint32)
-    lib.guber_crc32_batch(blob, offsets, n, out)
+    lib.guber_crc32_batch(as_char_p(blob), offsets, n, out)
     return out
 
 
@@ -184,15 +200,17 @@ class NativeSlotMap:
 
         return self.resolve_blob(*pack_blob(keys))
 
-    def resolve_blob(self, blob: bytes, offsets: np.ndarray):
+    def resolve_blob(self, blob, offsets: np.ndarray):
         """resolve_batch on pre-packed (blob, offsets) — the columnar hot
-        path's native call: no per-key Python at all."""
+        path's native call: no per-key Python at all.  ``blob`` may be any
+        bytes-like buffer (a numpy view into a shared-memory slab included);
+        non-bytes writable buffers are passed without copying."""
         n = len(offsets) - 1
         offsets = np.ascontiguousarray(offsets, np.int64)
         slots = np.empty(n, np.int64)
         known = np.empty(n, np.uint8)
         self._lib.guber_slotmap_resolve_batch(
-            self._h, blob, offsets, n, slots, known
+            self._h, as_char_p(blob), offsets, n, slots, known
         )
         return slots, known
 
@@ -224,12 +242,14 @@ class NativeSlotMap:
         mv = memoryview(blob)  # slice without copying the whole buffer
         return [bytes(mv[offsets[i] : offsets[i + 1]]) for i in range(len(slots))]
 
-    def assign_blob(self, blob: bytes, offsets: np.ndarray) -> np.ndarray:
+    def assign_blob(self, blob, offsets: np.ndarray) -> np.ndarray:
         """Assign keys packed as (blob, offsets); -1 = table full."""
         n = len(offsets) - 1
         offsets = np.ascontiguousarray(offsets, np.int64)
         out = np.empty(n, np.int64)
-        self._lib.guber_slotmap_assign_batch(self._h, blob, offsets, n, out)
+        self._lib.guber_slotmap_assign_batch(
+            self._h, as_char_p(blob), offsets, n, out
+        )
         return out
 
     def assign_batch(self, keys: List[bytes]) -> np.ndarray:

@@ -1851,12 +1851,14 @@ class SlotMap:
                 known[j] = 0
         return slots, known
 
-    def resolve_blob(self, blob: bytes, offsets: np.ndarray):
+    def resolve_blob(self, blob, offsets: np.ndarray):
         """(slots, known) for keys packed as one blob + offsets (the
         columnar hot-path format; NativeSlotMap resolves this with zero
-        per-key Python)."""
+        per-key Python).  ``blob`` may be any bytes-like buffer — slices
+        are coerced to bytes for the per-key decode."""
+        mv = memoryview(blob)
         return self.resolve_batch(
-            [blob[offsets[j] : offsets[j + 1]] for j in range(len(offsets) - 1)]
+            [bytes(mv[offsets[j] : offsets[j + 1]]) for j in range(len(offsets) - 1)]
         )
 
     def release_batch(self, slots: np.ndarray) -> None:

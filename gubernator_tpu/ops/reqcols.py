@@ -48,14 +48,18 @@ class ReqColumns:
     (``name + "_" + unique_key``, reference client.go:39-41): offsets are
     (n+1,) int64 with ``key j = blob[offsets[j]:offsets[j+1]]``, exactly
     the native slotmap's batch-resolve wire format (slotmap.cc
-    guber_slotmap_resolve_batch).
+    guber_slotmap_resolve_batch).  The blob may be ``bytes`` or any
+    bytes-like buffer — arena-backed batches carry a zero-copy numpy
+    view into the decode slab (shared-memory slabs included); every
+    consumer (native resolve, concat, per-key error paths) accepts the
+    buffer form.
 
     ``refs`` optionally carries the originating request objects for the
     paths that genuinely need them (Store read/write-through hooks take a
     ``RateLimitRequest``); the hot path never touches it.
     """
 
-    key_blob: bytes
+    key_blob: "bytes | np.ndarray | memoryview"
     key_offsets: np.ndarray   # (n+1,) int64
     hits: np.ndarray          # all remaining columns: (n,) int64
     limit: np.ndarray
@@ -90,7 +94,10 @@ class ReqColumns:
 
     def key_bytes(self, j: int) -> bytes:
         o = self.key_offsets
-        return self.key_blob[o[j] : o[j + 1]]
+        b = self.key_blob[o[j] : o[j + 1]]
+        # Buffer-backed blobs (arena/shm views) slice to a view; the
+        # error/retry paths that call this expect real bytes.
+        return b if type(b) is bytes else bytes(b)
 
     @classmethod
     def empty(cls) -> "ReqColumns":

@@ -263,6 +263,10 @@ class V1Instance:
                         fallback_limit=fallback_limit)
             if slabs > 0 else None
         )
+        # Multi-process streaming edge (docs/edge.md): attached by the
+        # daemon when GUBER_EDGE_WORKERS > 0; closed before the tick
+        # loop so in-flight shm windows resolve while it still runs.
+        self.edge_plane = None
         hash_fn = HASH_FUNCTIONS[conf.picker_hash]
         self._standalone = True  # no peers installed yet; see set_peers
         self.local_picker: ReplicatedConsistentHash[PeerClient] = (
@@ -1063,6 +1067,12 @@ class V1Instance:
     # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
+    def attach_edge_plane(self, plane) -> None:
+        """Adopt a started :class:`gubernator_tpu.edge.EdgePlane` so
+        :meth:`close` tears it down in the right order (before the tick
+        loop — its in-flight windows are tick futures over shm views)."""
+        self.edge_plane = plane
+
     async def close(self) -> None:
         """Graceful drain + shutdown (gubernator.go:151-170, extended per
         docs/persistence.md): finish in-flight ring work (ownership
@@ -1115,6 +1125,13 @@ class V1Instance:
                 self.conf.loader.save_columns(self.engine.export_columns())
             else:
                 self.conf.loader.save(self.engine.export_items())
+        if self.edge_plane is not None:
+            # The edge plane's in-flight windows are tick-loop futures
+            # holding zero-copy shm views; stop it while the loop can
+            # still resolve them (docs/edge.md shutdown ordering).
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.edge_plane.close
+            )
         self.tick_loop.close()
         if hasattr(self.engine, "close"):
             self.engine.close()

@@ -492,6 +492,7 @@ class Daemon:
             iconf.global_mesh = self._global_mesh
             iconf.global_mesh_node = self._global_mesh_node
         self.instance = await V1Instance.create(iconf, engine=self._engine)
+        self._start_edge_plane()
         server.add_generic_rpc_handlers(
             (
                 v1_handler(V1Servicer(self.instance)),
@@ -508,6 +509,34 @@ class Daemon:
             self.conf.grpc_listen_address,
             self.conf.http_listen_address,
         )
+
+    def _start_edge_plane(self) -> None:
+        """GUBER_EDGE_WORKERS > 0: bring up the shared-memory ingest
+        plane (docs/edge.md) — N decode worker processes, each exposing
+        a Unix-socket fastwire endpoint and feeding the tick loop
+        through its own shm slab ring.  At 0 (the default) nothing is
+        constructed: the serving path is byte-identical to the
+        single-process daemon and no shm segment ever exists."""
+        conf = self.conf.config
+        if conf.edge_workers <= 0:
+            return
+        from gubernator_tpu.service.instance import MAX_BATCH_SIZE
+        from gubernator_tpu.edge import EdgeConfig, EdgePlane
+
+        plane = EdgePlane(
+            self.instance.tick_loop,
+            EdgeConfig(
+                workers=conf.edge_workers,
+                slabs=conf.edge_shm_slabs,
+                ring_depth=conf.edge_ring_depth,
+                max_batch=MAX_BATCH_SIZE,
+                mode="socket",
+            ),
+            metrics=self.metrics,
+        )
+        plane.start()
+        self.instance.attach_edge_plane(plane)
+        log.info("edge ingest sockets: %s", ", ".join(plane.socket_paths()))
 
     # ------------------------------------------------------------------
     # HTTP gateway (grpc-gateway JSON + /metrics, daemon.go:245-292)
@@ -756,6 +785,8 @@ class Daemon:
                 "leases": arena.metric_leases,
                 "misses": arena.metric_misses,
             }
+        if inst.edge_plane is not None:
+            body["edge"] = inst.edge_plane.debug_state()
         engine_tel: dict = {}
         if hasattr(eng, "h2d_overlap_ratio"):
             engine_tel["h2d_windows"] = eng.metric_h2d_windows

@@ -106,8 +106,9 @@ def parse_req(
     falls back to ``pb.GetRateLimitsReq.FromString``).
 
     With ``arena`` (ops.reqcols.ColumnArena) the decode lands in a
-    preallocated slab and the returned columns are views into it —
-    zero per-window allocation besides the key blob's bytes.  The
+    preallocated slab and the returned columns — key blob included —
+    are views into it: zero per-window allocation and zero copies
+    (the native slotmap resolves the blob view in place).  The
     caller owns the lease: ``cols.release()`` once the engine has
     packed the batch (an unreleased lease just falls back to plain
     allocation when the arena runs dry, never corrupts).  Oversized
@@ -171,8 +172,13 @@ def parse_req(
     special = bool((flags & _HAS_METADATA).any()) or bool(
         (behavior & _GLOBAL).any()
     )
+    # The key blob stays a view into the decode buffer — the last copy
+    # on the decode path is gone.  Arena-backed batches alias the slab
+    # (valid until cols.release(), same lifetime as the other columns);
+    # the plain-allocation branch aliases the freshly-built buffer the
+    # columns already own.
     cols = ReqColumns(
-        blob[: off[n]].tobytes(), off, hits, limit, duration,
+        blob[: off[n]], off, hits, limit, duration,
         algorithm, behavior, created, burst, name_len=name_len,
         lease=lease,
     )
@@ -221,7 +227,7 @@ def encode_req(cols: ReqColumns, tag_peer: bool = False) -> Optional[bytes]:
     while True:
         out = np.empty(cap, np.uint8)
         wrote = lib.guber_encode_req(
-            cols.key_blob, off, name_len,
+            native_mod.as_char_p(cols.key_blob), off, name_len,
             np.ascontiguousarray(cols.hits, np.int64),
             np.ascontiguousarray(cols.limit, np.int64),
             np.ascontiguousarray(cols.duration, np.int64),

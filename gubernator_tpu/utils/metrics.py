@@ -661,6 +661,61 @@ class Metrics:
             registry=reg,
         )
 
+        # Multi-process streaming edge families (docs/edge.md): worker
+        # processes write a shm counter block; the owner's supervisor
+        # delta-syncs it into these, labelled per worker so one hot
+        # worker is visible as itself.
+        self.edge_decode_seconds = Counter(
+            "gubernator_tpu_edge_decode_seconds",
+            "Wire-decode CPU spent inside edge worker processes "
+            "(off the device-owner's GIL).",
+            ["worker"],
+            registry=reg,
+        )
+        self.edge_windows = Counter(
+            "gubernator_tpu_edge_windows",
+            "Request windows decoded and published into the shm slab "
+            "ring by each edge worker.",
+            ["worker"],
+            registry=reg,
+        )
+        self.edge_rows = Counter(
+            "gubernator_tpu_edge_rows",
+            "Request rows (rate-limit items) published by each edge "
+            "worker.",
+            ["worker"],
+            registry=reg,
+        )
+        self.edge_acked_windows = Counter(
+            "gubernator_tpu_edge_acked_windows",
+            "Windows whose response matrix came back through the shm "
+            "response ring and was acked by the worker.",
+            ["worker"],
+            registry=reg,
+        )
+        self.edge_backpressure_waits = Counter(
+            "gubernator_tpu_edge_backpressure_waits",
+            "Worker waits on its own full slab ring or response depth — "
+            "the per-producer backpressure bound engaging.",
+            ["worker"],
+            registry=reg,
+        )
+        self.edge_shed = Counter(
+            "gubernator_tpu_edge_shed",
+            "Edge rows shed retriably, by reason: 'local' (worker spun "
+            "out on its full ring), 'crash' (in-flight slabs of a dead "
+            "worker), 'shutdown' (plane close).",
+            ["worker", "reason"],
+            registry=reg,
+        )
+        self.edge_worker_restarts = Counter(
+            "gubernator_tpu_edge_worker_restarts",
+            "Edge worker processes respawned by the supervisor after a "
+            "crash.",
+            ["worker"],
+            registry=reg,
+        )
+
     def register_flag_collectors(self, metric_flags: int) -> None:
         """Register OS / runtime collectors behind ``GUBER_METRIC_FLAGS``
         (reference flags.go:20-23 + daemon.go:276-287).  "os" → process

@@ -97,6 +97,9 @@ COUNT_KEYS = (
     "ssd_continuity_errors",
     "ssd_tick_path_reads",
     "ssd_promote_batches_per_miss_tick",
+    "multiproc_parity_errors",
+    "multiproc_double_served",
+    "multiproc_dropped_acked",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -225,6 +228,9 @@ ABSOLUTE_ZERO_KEYS = (
     "lease_bucket_drift",
     "ssd_continuity_errors",
     "ssd_tick_path_reads",
+    "multiproc_parity_errors",
+    "multiproc_double_served",
+    "multiproc_dropped_acked",
 )
 
 

@@ -35,7 +35,8 @@ def _parity(reqs):
     )
     assert errors == ref_errors
     assert special == ref_special
-    assert cols.key_blob == ref_cols.key_blob
+    # parse_req blobs are buffer views (zero-copy decode); compare bytes.
+    assert bytes(cols.key_blob) == bytes(ref_cols.key_blob)
     np.testing.assert_array_equal(cols.key_offsets, ref_cols.key_offsets)
     for f in ("hits", "limit", "duration", "algorithm", "behavior",
               "created_at", "burst"):
@@ -385,7 +386,7 @@ def test_arena_decode_fuzz_parity():
         sc, se, ss = slab
         assert sc.lease is not None, "arena lease was not used"
         assert pe == se and ps == ss
-        assert pc.key_blob == sc.key_blob
+        assert bytes(pc.key_blob) == bytes(sc.key_blob)
         np.testing.assert_array_equal(pc.key_offsets, sc.key_offsets)
         for f in ("hits", "limit", "duration", "algorithm", "behavior",
                   "created_at", "burst", "name_len"):
@@ -397,7 +398,7 @@ def test_arena_decode_fuzz_parity():
             pb.GetRateLimitsReq.FromString(data).requests
         )
         assert se == ref_errors and ss == ref_special
-        assert sc.key_blob == ref_cols.key_blob
+        assert bytes(sc.key_blob) == bytes(ref_cols.key_blob)
         for f in ("hits", "limit", "duration", "algorithm", "behavior",
                   "created_at", "burst"):
             np.testing.assert_array_equal(

@@ -545,6 +545,11 @@ def test_repo_hot_path_markers_present():
         # Telemetry plane (docs/observability.md): the flight recorder's
         # record path runs inside every instrumented serving window.
         "gubernator_tpu/utils/flightrec.py": ["begin", "note", "finish"],
+        # Multi-process edge (docs/edge.md): the SPSC slab handoff and
+        # the owner's drain both run once per published window — G001's
+        # sync/file-syscall arms must keep them lock- and I/O-free.
+        "gubernator_tpu/edge/shmring.py": ["publish", "pop_published"],
+        "gubernator_tpu/edge/plane.py": ["_drain_once"],
         # SSD tier (docs/tiering.md): demote staging and the miss-path
         # batched lookup run on the dispatch thread — the file-syscall
         # arm of G001 keeps slab I/O on the background writer.
