@@ -2391,6 +2391,134 @@ def child_mesh_tick():
     )
 
 
+def child_reshard_live():
+    """Runs in the subprocess: elastic live resharding under traffic
+    (docs/resharding.md) — an 8-device mesh serving continuously while
+    the coordinator runs 8→4 and then 4→8 transitions through the full
+    freeze → drain → cutover → verify protocol.
+
+    Exports the transition's correctness gates
+    (scripts/check_bench_regression.py):
+
+      reshard_state_loss       rows live at relayout time missing after
+                               either cutover (ABSOLUTE_ZERO; both the
+                               coordinator's audit and an independent
+                               before/after key-set sweep feed it)
+      reshard_double_served    keys resident more than once after a
+                               cutover (ABSOLUTE_ZERO)
+      reshard_parity_errors    routed-path ownership vs the host ring on
+                               the post-transition layout (ABSOLUTE_ZERO)
+      reshard_p99_during_ms    p99 of client windows SERVED while the
+                               transitions run (sheds answer retriable
+                               errors and are counted separately) —
+                               lower-better with slack; a blowup means
+                               the freeze window stopped being bounded
+    """
+    jax.config.update("jax_platforms", "cpu")
+    import threading
+
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+    from gubernator_tpu.parallel.reshard import ReshardCoordinator
+    from gubernator_tpu.service.tickloop import TickLoop
+    from gubernator_tpu.types import RateLimitRequest
+
+    n_keys = 1 << 11
+    window = 256
+    rng = np.random.default_rng(17)
+
+    def reqs_for(ids):
+        return [
+            RateLimitRequest(
+                name="bench", unique_key=str(int(k)), hits=1,
+                limit=1_000_000, duration=3_600_000,
+            )
+            for k in ids
+        ]
+
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=1 << 9, max_batch=window,
+        routing="device",
+    )
+    loop = TickLoop(eng, batch_limit=window)
+    coord = ReshardCoordinator(eng, tick_loop=loop, freeze_timeout=60.0,
+                               verify=True)
+    # Prefill + warm the serving program on the 8-shard layout.
+    for start in range(0, n_keys, window):
+        loop.submit(reqs_for(range(start, start + window))).result(timeout=120)
+    keys_before = {it["key"] for it in eng.export_items()}
+
+    lat_ms = []
+    shed = [0]
+    served = [0]
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            ids = rng.integers(0, n_keys, size=window)
+            t0 = time.perf_counter()
+            try:
+                out = loop.submit(reqs_for(ids)).result(timeout=120)
+            except Exception:
+                continue
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            n_err = sum(1 for r in out if r.error)
+            if n_err:
+                shed[0] += n_err  # retriable freeze sheds, not losses
+                time.sleep(0.005)  # a well-behaved client backs off
+            else:
+                served[0] += 1
+                lat_ms.append(dt_ms)
+
+    driver = threading.Thread(target=drive, name="reshard-driver")
+    driver.start()
+    t0 = time.perf_counter()
+    try:
+        res_down = coord.reshard(4)
+        time.sleep(0.5)  # serve on the 4-shard layout mid-measurement
+        res_up = coord.reshard(8)
+        time.sleep(0.5)
+    finally:
+        stop.set()
+        driver.join()
+    transition_s = time.perf_counter() - t0
+
+    results = [res_down, res_up]
+    committed = sum(1 for r in results if r.get("outcome") == "committed")
+    loss = sum(r.get("state_loss", 0) for r in results)
+    dup = sum(r.get("double_served", 0) for r in results)
+    parity = sum(r.get("parity_errors", 0) for r in results)
+    # Independent sweep: every key resident before the transitions must
+    # still be resident after both (the driver only touches known keys).
+    keys_after = {it["key"] for it in eng.export_items()}
+    loss = max(loss, len(keys_before - keys_after))
+    parity = max(parity, int(eng.routing_parity_errors(sorted(keys_after))))
+    _, p99 = _pcts(lat_ms) if lat_ms else (0.0, 0.0)
+    loop.close()
+    out = {
+        "rung": "reshard_live",
+        "shards_path": "8->4->8",
+        "reshard_committed": committed,
+        "reshard_state_loss": int(loss),
+        "reshard_double_served": int(dup),
+        "reshard_parity_errors": int(parity),
+        "reshard_p99_during_ms": round(p99, 2),
+        "reshard_shed_retriable": int(shed[0]),
+        "served_windows_during": int(served[0]),
+        "live_items": len(keys_after),
+        "transition_wall_s": round(transition_s, 2),
+        "reshard_s_8to4": round(res_down.get("duration_s", 0.0), 2),
+        "reshard_s_4to8": round(res_up.get("duration_s", 0.0), 2),
+        "backend": "cpu-8dev",
+    }
+    if committed != 2:
+        out["error"] = (
+            f"expected 2 committed transitions, got {committed}: "
+            f"{[r.get('outcome') for r in results]}"
+            f" {[r.get('reason') for r in results]}"
+        )
+    print(json.dumps(out))
+
+
 def child_mesh_100m():
     """Runs in the subprocess: the 100M-key multichip rung — the full
     sharded SoA table (8 shards x 12.5M slots, columns layout: 80 B/slot
@@ -2763,6 +2891,12 @@ def rung_mesh_tick():
     return _run_child("--child-mesh-tick", "mesh_tick_8")
 
 
+def rung_reshard_live():
+    # Two full transitions (each pays a fresh shard-set build + warmup
+    # on the CPU venue) under a live driver thread; give the child room.
+    return _run_child("--child-reshard-live", "reshard_live", timeout=1200)
+
+
 def rung_mesh_100m():
     # 8 GB of sharded table + ~8 GB of native slotmaps, populated
     # device-side; the dominant cost is the 100M host key inserts.
@@ -2938,6 +3072,7 @@ def main():
     ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
+    ladder.append(_safe("reshard_live", rung_reshard_live))
     ladder.append(_safe("mesh_100m_multichip", rung_mesh_100m))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
@@ -3113,6 +3248,12 @@ def compact_headline(record, ladder_file):
         # efficiency is direction-aware (must not decay vs baseline).
         "mesh_routing_parity_errors", "mesh_dropped_keys",
         "mesh_double_served", "mesh_scaling_efficiency",
+        # Elastic resharding gates (docs/resharding.md): zero bucket loss
+        # and zero double-residency through an n->m cutover are
+        # ABSOLUTE_ZERO, client p99 through the transition is
+        # lower-better with slack.
+        "reshard_state_loss", "reshard_double_served",
+        "reshard_parity_errors", "reshard_p99_during_ms",
         # Overload control gates (docs/overload.md): expired-but-served
         # is ABSOLUTE_ZERO, admitted p99 is lower-better, goodput under
         # ~10x load must hold its floor, RSS growth is bounded.
@@ -3145,6 +3286,8 @@ if __name__ == "__main__":
         child_mesh_100m()
     elif "--child-mesh-tick" in sys.argv:
         child_mesh_tick()
+    elif "--child-reshard-live" in sys.argv:
+        child_reshard_live()
     elif "--child-mesh" in sys.argv:
         child_mesh()
     elif "--child-global-sparse" in sys.argv:

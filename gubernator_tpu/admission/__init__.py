@@ -65,6 +65,10 @@ SHED_SHUTDOWN_MSG = (
 SHED_BACKPRESSURE_MSG = (
     "request shed: ingest arena exhausted; retry after backoff"
 )
+SHED_RESHARD_MSG = (
+    "request shed: shard transition in progress; retry after the "
+    "cutover window"
+)
 
 
 @dataclass

@@ -118,6 +118,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_REDELIVERY_LIMIT": "GLOBAL redelivery buffer cap",
     "GUBER_REPLICATED_HASH_REPLICAS": "consistent-hash virtual replicas",
     "GUBER_REQUEST_TIMEOUT": "default per-request deadline budget",
+    "GUBER_RESHARD_FREEZE_TIMEOUT": "reshard drain budget before abort",
+    "GUBER_RESHARD_VERIFY": "audit the table after each reshard cutover",
     "GUBER_RESOLV_CONF": "dns discovery: resolv.conf path",
     "GUBER_SHED_POLICY": "overload shed answers: fail-open/fail-closed",
     "GUBER_SLOW_WINDOW_MS": "slow-window watchdog threshold in ms (0 = off)",
@@ -296,6 +298,15 @@ class Config:
     # hit/broadcast/redelivery flush inside GlobalManager.close so a
     # dead peer can't wedge shutdown.  GUBER_DRAIN_TIMEOUT
     drain_timeout: float = 2.0
+
+    # Elastic live resharding (docs/resharding.md): the bounded quiesce
+    # budget before the cutover — a drain that misses it aborts the
+    # transition (GUBER_RESHARD_FREEZE_TIMEOUT) — and whether the
+    # post-cutover table is audited for loss/double-residency before
+    # admission unfreezes (GUBER_RESHARD_VERIFY; the audit is a full
+    # readback, so very large tables may opt out).
+    reshard_freeze_timeout: float = 5.0
+    reshard_verify: bool = True
 
     # Multi-process streaming edge (docs/edge.md): N decode worker
     # processes feeding the tick loop through shared-memory slab rings.
@@ -608,6 +619,9 @@ def setup_daemon_config(
             "GUBER_SNAPSHOT_DELTAS_PER_BASE", 64
         ),
         drain_timeout=r.float_seconds("GUBER_DRAIN_TIMEOUT", 2.0),
+        reshard_freeze_timeout=r.float_seconds(
+            "GUBER_RESHARD_FREEZE_TIMEOUT", 5.0),
+        reshard_verify=r.bool_("GUBER_RESHARD_VERIFY", True),
         edge_workers=r.int_("GUBER_EDGE_WORKERS", 0),
         edge_shm_slabs=r.int_("GUBER_EDGE_SHM_SLABS", 8),
         edge_ring_depth=r.int_("GUBER_EDGE_RING_DEPTH", 16),
@@ -653,6 +667,20 @@ def setup_daemon_config(
         raise ValueError(
             "GUBER_SSD_DIR requires GUBER_COLD_CACHE_SIZE > 0: the SSD "
             "tier only ever holds cold-tier overflow"
+        )
+    if conf.ssd_dir and conf.tpu_mesh_shards > 1:
+        # Hard error, not warn+disable: a silently absent third tier is
+        # a robustness trap at reshard scale — the operator sized the
+        # deployment around capacity the engine never had.
+        raise ValueError(
+            "GUBER_SSD_DIR is not supported by the sharded mesh engine "
+            "(GUBER_TPU_MESH_SHARDS > 1): the SSD tier hangs off the "
+            "single-chip cold store; unset one of the two"
+        )
+    if conf.reshard_freeze_timeout <= 0:
+        raise ValueError(
+            f"GUBER_RESHARD_FREEZE_TIMEOUT must be > 0; "
+            f"got {conf.reshard_freeze_timeout}"
         )
     if conf.ssd_capacity_bytes <= 0:
         raise ValueError(

@@ -914,6 +914,7 @@ class MeshGlobalEngine:
         self._pending: set = set()
         self._tick_count = 0
         self._last_reconcile_ms = 0
+        self._reconcile_paused = 0
         self._lock = threading.RLock()
         self.metric_reconciles = 0
         self._req_sharding = mat
@@ -1146,9 +1147,25 @@ class MeshGlobalEngine:
             self._last_reconcile_ms = now
             self.metric_reconciles += 1
 
+    def pause_reconcile(self) -> None:
+        """Hold the reconcile cadence (nestable): the reshard coordinator
+        quiets the collective plane for its bounded cutover window so
+        reconcile programs don't contend with the relayout dispatch on
+        the same devices (docs/resharding.md).  Hits keep accumulating —
+        a paused cadence defers reconciliation, it never loses it."""
+        with self._lock:
+            self._reconcile_paused += 1
+
+    def resume_reconcile(self) -> None:
+        with self._lock:
+            self._reconcile_paused = max(0, self._reconcile_paused - 1)
+
     def maybe_reconcile(self, now: Optional[int] = None) -> bool:
         """Reconcile unless one ran within ``min_reconcile_ms`` (lets every
-        resident node drive the cadence without duplicate work)."""
+        resident node drive the cadence without duplicate work) or the
+        cadence is paused for a reshard cutover."""
+        if self._reconcile_paused:
+            return False
         now = now if now is not None else timeutil.now_ms()
         if now - self._last_reconcile_ms < self.min_reconcile_ms:
             return False

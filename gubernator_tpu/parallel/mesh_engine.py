@@ -94,7 +94,10 @@ from gubernator_tpu.ops.engine import (
     unpack_resp_compact,
 )
 from gubernator_tpu.parallel.partition import (
+    LayoutTransition,
     ShardLayout,
+    plan_transition,
+    relayout_block,
     route_block,
     scatter_flat,
 )
@@ -514,6 +517,11 @@ class MeshTickEngine:
         if routing not in ("auto", "device", "host"):
             raise ValueError(f"unknown mesh routing {routing!r}")
         self.routing = "host" if routing == "host" else "device"
+        # As-configured knobs, kept verbatim so reshard() can re-derive
+        # the auto choices (layout fit, routed block width) for the new
+        # shard count instead of freezing this build's resolution.
+        self._table_layout_conf = table_layout
+        self._local_width_conf = int(local_width)
         if not local_width:
             # ~B/n with 25% headroom for hash imbalance, 64-lane
             # quantized; adversarially skewed windows fall back to the
@@ -551,7 +559,9 @@ class MeshTickEngine:
                 "GUBER_TICK_PIPELINE_DEPTH", 4, parse=int))
         except ValueError:
             _depth = 4
-        self._staging = StagingRing(REQ32_ROWS, self.capacity, 2 * _depth + 1)
+        self._staging_slabs = 2 * _depth + 1
+        self._staging = StagingRing(
+            REQ32_ROWS, self.capacity, self._staging_slabs)
         self._inflight = 0
         self.metric_h2d_windows = 0
         self.metric_h2d_overlapped = 0
@@ -1316,6 +1326,189 @@ class MeshTickEngine:
                 self.state = self.ops.restore(
                     self.state, self.ops.put3(ints), self.ops.put2(floats)
                 )
+
+    # ------------------------------------------------------------------
+    # Elastic live resharding (docs/resharding.md).  The n→m transition
+    # is planned by partition.plan_transition — the ONE layout-transition
+    # spec — moved on device by a collective all-to-all keyed by
+    # ``slot // cap_to`` (partition.relayout_block), and committed by an
+    # atomic host-side cutover that swaps every layout-bearing field at
+    # once.  Nothing before the cutover mutates the serving layout, so
+    # any failure up to (and inside) it rolls back to the old layout
+    # with the table untouched.
+    # ------------------------------------------------------------------
+    @hot_path
+    def _dispatch_relayout(self, tr: LayoutTransition):
+        """Run the transition all-to-all on the OLD mesh: every shard
+        scatters its live rows into a zeroed new-layout buffer at the
+        spec-derived target (``slot // cap_to``, ``slot % cap_to``) and
+        one ``psum`` completes the exchange — the re-layout itself is
+        collective device work, not a per-shard host gather.  Returns
+        the replicated new-flat-layout table (device arrays, one D2H
+        away); traces once per transition shape and never touches the
+        serving programs' signatures."""
+        cap_from = self.local_capacity
+        if self.layout == "row":
+            def _relayout(state_blk):
+                self.ops.trace_counts["relayout"] += 1
+                my = lax.axis_index("shard")
+                return lax.psum(
+                    relayout_block(state_blk.table[:cap_from], my, tr),
+                    "shard",
+                )
+
+            out_specs = P(None, None)
+        else:
+            def _relayout(state_blk):
+                self.ops.trace_counts["relayout"] += 1
+                my = lax.axis_index("shard")
+                return jax.tree.map(
+                    lambda a: lax.psum(relayout_block(a, my, tr), "shard"),
+                    state_blk,
+                )
+
+            out_specs = jax.tree.map(lambda _: P(None), BucketState.zeros(0))
+        prog = jax.jit(
+            shard_map(
+                _relayout, mesh=self.mesh,
+                in_specs=(self.ops.state_spec,), out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+        # No donation: the old state must survive for abort-and-rollback.
+        return prog(self.state)
+
+    def _transition_items(self, flat) -> tuple:
+        """Materialize the re-laid-out table and pair each live slot with
+        its key: the host half of the transition.  Because the spec's
+        flat remap is the identity on live slots, old global slot ``g``
+        addresses row ``g`` of the relayout output directly — the keys
+        come from the old slotmaps, the state from the collective."""
+        if self.layout == "row":
+            rows = np.ascontiguousarray(np.asarray(flat))
+            st = rowtable.host_columns_from_rows(rows)
+        else:
+            st = jax.tree.map(np.asarray, flat)
+        mapped = np.concatenate([sm.mapped_mask() for sm in self.slots])
+        live = np.flatnonzero(mapped & st.in_use[: self.capacity])
+        if len(live) == 0:
+            return [], 0
+        keys: List[bytes] = []
+        owner = live // self.local_capacity
+        for d in range(self.n_shards):
+            sel = live[owner == d] - d * self.local_capacity
+            if len(sel):
+                keys.extend(self.slots[d].keys_batch(sel))
+        return items_from_columns(keys, st, live), len(live)
+
+    def _build_shard_set(self, tr: LayoutTransition, devices):
+        """Everything the new layout needs, built OFF to the side (the
+        old layout keeps serving identity until the cutover swap): mesh,
+        compiled ShardedOps, zeroed sharded state, per-shard slotmaps,
+        staging ring."""
+        from types import SimpleNamespace
+
+        from gubernator_tpu.ops.engine import make_slot_map
+
+        mesh = Mesh(np.array(list(devices)), ("shard",))
+        layout = make_layout_choice(
+            self._table_layout_conf, tr.cap_to, mesh.devices.flat[0],
+            self.max_batch,
+        )
+        lw = self._local_width_conf
+        if not lw:
+            lw = max(64, -(-5 * self.max_batch // (4 * tr.n_to)))
+            lw = -(-lw // 64) * 64
+        lw = min(int(lw), self.max_batch)
+        ops = ShardedOps(mesh, tr.cap_to, layout, local_width=lw)
+        return SimpleNamespace(
+            mesh=mesh, n_shards=tr.n_to, local_capacity=tr.cap_to,
+            capacity=tr.capacity_to, local_width=lw, layout=layout,
+            ops=ops, state=ops.init_state(),
+            slots=[make_slot_map(tr.cap_to) for _ in range(tr.n_to)],
+            last_access=np.zeros(tr.capacity_to, np.int64),
+            staging=StagingRing(
+                REQ32_ROWS, tr.capacity_to, self._staging_slabs),
+        )
+
+    @hot_path
+    def _cutover(self, new, items, now) -> None:
+        """Atomically swap the serving layout to ``new`` and re-home the
+        live items (keys re-route to ``crc32 % m`` so the ownership rule
+        — route == ring == ``slot // local_capacity`` — holds in the new
+        layout).  Every layout-bearing field swaps together under the
+        engine lock; any failure restores the saved old layout verbatim
+        (the old state was never donated), so the abort path is a plain
+        tuple assignment — zero loss either way."""
+        saved = (
+            self.mesh, self.n_shards, self.local_capacity, self.capacity,
+            self.local_width, self.layout, self.ops, self.state,
+            self.slots, self._last_access, self._staging, self._pending,
+        )
+        self.mesh = new.mesh
+        self.n_shards = new.n_shards
+        self.local_capacity = new.local_capacity
+        self.capacity = new.capacity
+        self.local_width = new.local_width
+        self.layout = new.layout
+        self.ops = new.ops
+        self.state = new.state
+        self.slots = new.slots
+        self._last_access = new.last_access
+        self._staging = new.staging
+        self._pending = set()
+        self._inflight = 0
+        try:
+            if items:
+                self.load_items(items, now)
+            self._warmup()
+        except Exception:
+            (
+                self.mesh, self.n_shards, self.local_capacity,
+                self.capacity, self.local_width, self.layout, self.ops,
+                self.state, self.slots, self._last_access, self._staging,
+                self._pending,
+            ) = saved
+            raise
+
+    def reshard(self, new_shards: int, devices=None,
+                now: Optional[int] = None) -> dict:
+        """Re-layout the live table over ``new_shards`` devices, in
+        place, under the engine lock (callers quiesce the tick pipeline
+        first — the ReshardCoordinator's job; a straggler window merely
+        serializes behind the lock and resolves against whichever layout
+        it observes).  Returns a summary dict; raises — with the old
+        layout intact — on any failure before or inside the cutover."""
+        new_n = int(new_shards)
+        if new_n < 1:
+            raise ValueError(f"new_shards must be >= 1; got {new_n}")
+        with self._lock:
+            if new_n == self.n_shards:
+                return {
+                    "from_shards": self.n_shards, "to_shards": new_n,
+                    "live_items": 0, "noop": True,
+                }
+            avail = list(devices) if devices is not None else jax.devices()
+            if len(avail) < new_n:
+                raise ValueError(
+                    f"reshard to {new_n} shards needs {new_n} devices; "
+                    f"{len(avail)} available"
+                )
+            tr = plan_transition(self.n_shards, self.local_capacity, new_n)
+            if tr.capacity_to >= (1 << 31):
+                raise ValueError(
+                    f"resharded capacity {tr.capacity_to} exceeds int32 "
+                    "global slots"
+                )
+            flat = self._dispatch_relayout(tr)
+            items, n_live = self._transition_items(flat)
+            new = self._build_shard_set(tr, avail[:new_n])
+            self._cutover(new, items, now)
+            return {
+                "from_shards": tr.n_from, "to_shards": tr.n_to,
+                "cap_from": tr.cap_from, "cap_to": tr.cap_to,
+                "live_items": n_live, "noop": False,
+            }
 
     def routing_parity_errors(self, keys: Sequence[str]) -> int:
         """Audit key→shard routing parity for ``keys`` (post-serving):

@@ -37,6 +37,7 @@ from dataclasses import dataclass
 import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.buckets import BucketState
@@ -172,3 +173,121 @@ def scatter_flat(resp: jnp.ndarray, src: jnp.ndarray, b: int) -> jnp.ndarray:
     if resp.ndim == 1:
         return out.at[src].set(resp, mode="drop")
     return out.at[:, src].set(resp, mode="drop")
+
+
+# ----------------------------------------------------------------------
+# Layout transitions (elastic resharding; docs/resharding.md).  THE one
+# n→m transition spec: both the on-device all-to-all re-layout program
+# and every host-side remap audit derive ownership from this dataclass,
+# so the engine, the bench verifier, and the unit tests can never drift
+# on where a live slot lands after a reshard.
+# ----------------------------------------------------------------------
+@dataclass(frozen=True)
+class LayoutTransition:
+    """One n→m re-partitioning of the slot space.
+
+    Global slot identity is preserved across the transition: slot ``g``
+    of the old layout is slot ``g`` of the new one — only the ownership
+    boundaries move.  Under the contiguous-range rule (``ShardLayout``:
+    shard ``d`` owns ``[d*cap, (d+1)*cap)``) the new owner of ``g`` is
+    ``g // cap_to`` and its new local offset ``g % cap_to`` — the same
+    single derivation :func:`route_block` applies to request slots, now
+    applied to the table itself.
+
+    ``live_slots`` is the number of slots carrying state (the old
+    layout's total capacity on a first transition); ``cap_to`` is sized
+    ``ceil(live_slots / n_to)`` so every live slot fits, and threading
+    ``live_slots`` through chained transitions (:meth:`then`) makes
+    n→m→n a round trip: 8→3→8 at cap 128 passes through cap 342 and
+    lands back at exactly cap 128."""
+
+    n_from: int
+    cap_from: int
+    n_to: int
+    cap_to: int
+    live_slots: int
+
+    # -- ownership derivation (host/np + traced/jnp alike) -------------
+    def owner_of(self, g):
+        """New owning shard of global slot ``g`` (vector or scalar)."""
+        return g // self.cap_to
+
+    def local_of(self, g):
+        """New local offset of global slot ``g`` (vector or scalar)."""
+        return g % self.cap_to
+
+    def old_owner_of(self, g):
+        """Old owning shard of global slot ``g``."""
+        return g // self.cap_from
+
+    @property
+    def capacity_to(self) -> int:
+        return self.n_to * self.cap_to
+
+    @property
+    def capacity_from(self) -> int:
+        return self.n_from * self.cap_from
+
+    def then(self, n_next: int) -> "LayoutTransition":
+        """Chain a follow-up transition, threading ``live_slots`` so
+        round trips are exact (8→3→8 == identity)."""
+        return plan_transition(
+            self.n_to, self.cap_to, n_next, live_slots=self.live_slots
+        )
+
+    def remap(self) -> np.ndarray:
+        """(live_slots, 3) host audit table: ``[new_shard, new_local,
+        new_flat]`` per live global slot — new_flat is provably the
+        identity (``owner*cap_to + local == g``), which is what makes
+        the device all-to-all a pure re-partitioning of the flat slot
+        axis."""
+        g = np.arange(self.live_slots, dtype=np.int64)
+        own = self.owner_of(g)
+        loc = self.local_of(g)
+        return np.stack([own, loc, own * self.cap_to + loc], axis=1)
+
+
+def plan_transition(
+    n_from: int, cap_from: int, n_to: int, live_slots: int = None
+) -> LayoutTransition:
+    """Mint the :class:`LayoutTransition` for an n→m reshard.
+
+    ``live_slots`` defaults to the old layout's full capacity
+    (``n_from * cap_from``); pass a carried value when chaining (see
+    :meth:`LayoutTransition.then`)."""
+    if n_from < 1 or n_to < 1:
+        raise ValueError(
+            f"shard counts must be >= 1; got {n_from}→{n_to}")
+    if cap_from < 1:
+        raise ValueError(f"cap_from must be >= 1; got {cap_from}")
+    live = n_from * cap_from if live_slots is None else int(live_slots)
+    if not 0 < live <= n_from * cap_from:
+        raise ValueError(
+            f"live_slots {live} outside (0, {n_from * cap_from}]")
+    cap_to = -(-live // n_to)  # ceil: every live slot keeps a home
+    return LayoutTransition(
+        n_from=int(n_from), cap_from=int(cap_from),
+        n_to=int(n_to), cap_to=int(cap_to), live_slots=live,
+    )
+
+
+def relayout_block(x: jnp.ndarray, my: jnp.ndarray,
+                   tr: LayoutTransition) -> jnp.ndarray:
+    """Device-side half of the transition all-to-all (traced; runs per
+    OLD shard inside a ``shard_map``).
+
+    ``x`` is this shard's ``(cap_from, ...)`` slice of one table array
+    (guard rows already stripped by the caller).  Each row's target
+    placement in the NEW layout is derived from its global slot alone —
+    ``slot // cap_to`` picks the new owner, ``slot % cap_to`` the new
+    local offset — mirroring :func:`route_block`'s ownership rule.  The
+    scatter lands rows in a zeroed ``(n_to * cap_to, ...)`` buffer;
+    summing the per-shard buffers over the shard axis (one ``psum``,
+    the caller's half) completes the exchange, because live slot ranges
+    are disjoint across old shards."""
+    g = my.astype(jnp.int64) * tr.cap_from + jnp.arange(
+        tr.cap_from, dtype=jnp.int64
+    )
+    tgt = tr.owner_of(g) * tr.cap_to + tr.local_of(g)
+    buf = jnp.zeros((tr.capacity_to,) + x.shape[1:], x.dtype)
+    return buf.at[tgt].set(x, mode="drop")

@@ -22,12 +22,20 @@ from gubernator_tpu.persistence.snapshot import (
     snapshot_items,
     write_record,
 )
+from gubernator_tpu.persistence.transition import (
+    TransitionLog,
+    TransitionRecord,
+    check_interrupted,
+)
 from gubernator_tpu.persistence.writer import SnapshotWriter
 
 __all__ = [
     "RestoreResult",
     "SnapshotStore",
     "SnapshotWriter",
+    "TransitionLog",
+    "TransitionRecord",
+    "check_interrupted",
     "decode_snapshot",
     "encode_snapshot",
     "read_records",

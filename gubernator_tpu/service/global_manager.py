@@ -111,6 +111,19 @@ class GlobalManager:
         self._updates[key] = req
         if key in self._owned or len(self._owned) < self.resilience.redelivery_limit:
             self._owned[key] = req
+        else:
+            # Tracker full (GUBER_REDELIVERY_LIMIT): this key's state will
+            # NOT ride a ring-swap handoff.  Never silent — at reshard
+            # scale a quietly lossy tracker re-creates the bug the
+            # handoff machinery exists to prevent.
+            if self.metrics is not None:
+                self.metrics.ownership_transfers.labels(
+                    result="untracked").inc()
+            log.warning(
+                "ownership tracker full (%d keys, GUBER_REDELIVERY_LIMIT"
+                "=%d): %r will not be handed off on a ring change",
+                len(self._owned), self.resilience.redelivery_limit, key,
+            )
         if self.metrics is not None:
             self.metrics.global_queue_length.set(len(self._updates))
         self._updates_kick.set()

@@ -97,6 +97,10 @@ class InstanceConfig:
     snapshot_interval: float = 5.0
     snapshot_deltas_per_base: int = 64
     drain_timeout: float = 2.0
+    # Elastic live resharding (docs/resharding.md): quiesce budget
+    # before the cutover aborts, and the post-cutover table audit.
+    reshard_freeze_timeout: float = 5.0
+    reshard_verify: bool = True
     # GLOBAL collectives data plane (parallel/global_mesh.py): a shared
     # MeshGlobalEngine (mesh-resident peers) + this node's index on it.
     # When set, GLOBAL requests bypass the gRPC hits/broadcast loops.
@@ -142,6 +146,8 @@ class InstanceConfig:
             snapshot_interval=conf.snapshot_interval,
             snapshot_deltas_per_base=conf.snapshot_deltas_per_base,
             drain_timeout=conf.drain_timeout,
+            reshard_freeze_timeout=conf.reshard_freeze_timeout,
+            reshard_verify=conf.reshard_verify,
             tpu_global_mesh_nodes=conf.tpu_global_mesh_nodes,
             tpu_global_mesh_node=conf.tpu_global_mesh_node,
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
@@ -168,9 +174,14 @@ def _make_engine(conf: InstanceConfig):
                 "mesh engine yet; tiering disabled"
             )
         if conf.ssd_dir:
-            log.warning(
+            # Hard error (setup_daemon_config rejects this combination
+            # too): a silently absent third tier is a robustness trap —
+            # the operator sized the deployment around capacity the
+            # engine never had.
+            raise ValueError(
                 "GUBER_SSD_DIR is not supported by the sharded mesh "
-                "engine yet; SSD tier disabled"
+                "engine (GUBER_TPU_MESH_SHARDS > 1): the SSD tier "
+                "hangs off the single-chip cold store; unset one"
             )
         devices = jax.devices()[: conf.tpu_mesh_shards]
         local_cap = max(1, conf.cache_size // len(devices))
@@ -333,6 +344,25 @@ class V1Instance:
         self.lease_mgr = LeaseManager(
             self.engine, tick_loop=self.tick_loop, metrics=self.metrics,
         )
+        # Elastic live resharding (docs/resharding.md): the n→m
+        # transition coordinator over this instance's engine + tick
+        # loop.  The transition journal shares the snapshot directory;
+        # peer breakers gate the cutover (a mid-transfer peer death
+        # aborts rather than cutting over blind).
+        from gubernator_tpu.parallel.reshard import ReshardCoordinator
+        from gubernator_tpu.persistence import TransitionLog
+
+        self.reshard_coord = ReshardCoordinator(
+            self.engine,
+            tick_loop=self.tick_loop,
+            transition_log=TransitionLog(conf.snapshot_dir or None),
+            breaker_check=lambda: any(
+                p.breaker.is_open() for p in self.get_peer_list()),
+            global_engine=self.global_mesh,
+            metrics=self.metrics,
+            freeze_timeout=conf.reshard_freeze_timeout,
+            verify=conf.reshard_verify,
+        )
         # Crash-safe persistence (docs/persistence.md): wired by create().
         self._snapshot_writer = None
         self.restore_stats: dict = {}
@@ -354,6 +384,16 @@ class V1Instance:
                 inst.engine.load_items(list(items))
         if conf.snapshot_dir and hasattr(inst.engine, "load_columns"):
             await inst._start_persistence()
+        # Crash-mid-cutover detection (docs/resharding.md): a begin
+        # record with no terminal record means the process died inside a
+        # reshard transition — the snapshot just restored (never mutated
+        # mid-flight) is authoritative; count and clear the stale
+        # journal.
+        from gubernator_tpu.persistence import check_interrupted
+
+        rec = check_interrupted(inst.reshard_coord.transition_log)
+        if rec is not None:
+            inst.reshard_coord.record_interrupted(rec)
         return inst
 
     async def _start_persistence(self) -> None:
@@ -909,6 +949,33 @@ class V1Instance:
         Credit-backs and excess force-charges flow through the tick
         loop in the peer class."""
         return await self.lease_mgr.sync(list(syncs))
+
+    # ------------------------------------------------------------------
+    # Elastic live resharding (docs/resharding.md)
+    # ------------------------------------------------------------------
+    async def reshard(self, new_shards: int) -> dict:
+        """Run one n→m transition (admin-triggered via POST
+        /debug/reshard).  The coordinator's freeze/drain/cutover is
+        blocking device + lock work, so it runs in a worker thread; the
+        event loop keeps serving the shed-with-retriable answers the
+        freeze produces.  After a committed transition, tracked GLOBAL
+        keys re-broadcast through the PR 4 ownership-handoff path so
+        any peer holding pre-transition state converges."""
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, self.reshard_coord.reshard, int(new_shards)
+        )
+        if result.get("outcome") == "committed" and self.global_mgr._owned:
+            t = asyncio.get_running_loop().create_task(
+                self.global_mgr.transfer_ownership(),
+                name="reshard-ownership-rebroadcast",
+            )
+            self._transfer_tasks.add(t)
+            t.add_done_callback(self._transfer_tasks.discard)
+        return result
+
+    def reshard_status(self) -> dict:
+        """Coordinator phase/outcome snapshot for /debug/state."""
+        return self.reshard_coord.status()
 
     # ------------------------------------------------------------------
     # Health / peers

@@ -31,6 +31,7 @@ from gubernator_tpu.admission import (
     CLASS_CLIENT,
     POLICY_FAIL_CLOSED,
     SHED_EXPIRED_MSG,
+    SHED_RESHARD_MSG,
     SHED_SHUTDOWN_MSG,
     AdmissionConfig,
     AdmissionQueue,
@@ -145,6 +146,15 @@ class TickLoop:
         self._cond = threading.Condition()
         self._pending_count = 0
         self._running = True
+        # Reshard admission freeze (docs/resharding.md): level 1 sheds
+        # new CLIENT windows with a retriable status while PEER windows
+        # keep draining; level 2 (cutover) sheds both.  Queued work is
+        # never dropped by a freeze — it drains through _flush as usual.
+        self._freeze_level = 0
+        # Windows handed to the resolver but not yet delivered; quiesce()
+        # waits for this to reach zero (resolve_q.empty() alone races the
+        # resolver's in-progress item).
+        self._inflight_windows = 0
         self._resolve_q: "queue.Queue" = queue.Queue(
             maxsize=self.pipeline_depth)
         self._thread = threading.Thread(
@@ -200,14 +210,27 @@ class TickLoop:
                 fut.set_exception(RuntimeError("tick loop is shut down"))
                 return fut
             item = QueueItem(kind, payload, n, fut, deadline, klass)
-            shed = self._queue.push(item)
-            self._pending_count = self._queue.requests
-            if self.metrics is not None:
-                self.metrics.worker_queue_length.labels(
-                    method="GetRateLimits", worker="0"
-                ).set(self._pending_count)
-                self.metrics.admission_queue_depth.set(self._pending_count)
-            self._cond.notify()
+            lvl = self._freeze_level
+            if lvl and (lvl >= 2 or klass == CLASS_CLIENT):
+                frozen, shed = item, ()
+            else:
+                frozen = None
+                shed = self._queue.push(item)
+                self._pending_count = self._queue.requests
+                if self.metrics is not None:
+                    self.metrics.worker_queue_length.labels(
+                        method="GetRateLimits", worker="0"
+                    ).set(self._pending_count)
+                    self.metrics.admission_queue_depth.set(
+                        self._pending_count)
+                self._cond.notify()
+        if frozen is not None:
+            # Answered outside the lock like overflow victims: a frozen
+            # window gets the retriable reshard status immediately (it
+            # was never queued), so callers retry after the bounded
+            # cutover instead of waiting it out.
+            self._shed_item(frozen, "reshard")
+            return fut
         # Answer overflow victims outside the lock: they are already
         # unlinked from the queue, and shed answers may release arena
         # leases / complete futures with waiting callbacks.
@@ -244,7 +267,14 @@ class TickLoop:
                     width = min(width, self.limiter.window_limit)
                 batch = self._queue.pop_window(width)
                 self._pending_count = self._queue.requests
-            self._flush(batch)
+                # Count the window from the moment it leaves the queue:
+                # quiesce must see a batch wedged inside engine dispatch
+                # (it is neither queued nor at the resolver yet, but the
+                # cutover cannot run until it resolves).
+                if batch:
+                    self._inflight_windows += 1
+            if batch:
+                self._flush(batch)
 
     @hot_path
     def _flush(self, batch: List[QueueItem]) -> None:
@@ -262,6 +292,7 @@ class TickLoop:
             for it in expired:
                 self._shed_item(it, "expired")
         if not batch:
+            self._window_done()
             return
         # Flight-recorder window open (docs/observability.md): the engine
         # notes lease/pack/h2d into the active window while we dispatch.
@@ -323,13 +354,22 @@ class TickLoop:
             if fr is not None and wid is not None:
                 fr.end_dispatch(wid)
                 fr.finish(wid)
+            self._window_done()
             return
         if fr is not None and wid is not None:
             fr.end_dispatch(wid)
         # Bounded handoff: blocks when pipeline_depth windows are already
         # in flight (device behind), which is exactly the backpressure the
-        # dispatch thread should feel.
+        # dispatch thread should feel.  The in-flight count was taken at
+        # pop time in _run; the resolver releases it after the D2H drain.
         self._resolve_q.put((subs, time.perf_counter() - t0, wid))
+
+    def _window_done(self) -> None:
+        """Release one window's in-flight count without a resolver trip
+        (the window shed or failed entirely before dispatch)."""
+        with self._cond:
+            self._inflight_windows = max(0, self._inflight_windows - 1)
+            self._cond.notify_all()
 
     def _resolve_loop(self) -> None:
         while True:
@@ -399,6 +439,10 @@ class TickLoop:
                         )
                 if fr is not None and wid is not None:
                     fr.finish(wid)
+            with self._cond:
+                self._inflight_windows = max(
+                    0, self._inflight_windows - len(items))
+                self._cond.notify_all()
             if stop:
                 return
 
@@ -428,20 +472,71 @@ class TickLoop:
             _complete(fut, out[off : off + n])
             off += n
 
+    # ------------------------------------------------------------------
+    # Reshard admission freeze (docs/resharding.md)
+    # ------------------------------------------------------------------
+    def freeze(self, shed_peers: bool = False) -> None:
+        """Stop admitting new windows into the transition epoch: CLIENT
+        submissions answer the retriable reshard status immediately;
+        PEER submissions keep draining (they outrank clients and must
+        land before the cutover) until ``shed_peers`` escalates the
+        freeze for the bounded cutover itself.  Idempotent; never
+        downgrades an escalated freeze."""
+        with self._cond:
+            self._freeze_level = max(
+                self._freeze_level, 2 if shed_peers else 1)
+
+    def unfreeze(self) -> None:
+        with self._cond:
+            self._freeze_level = 0
+            self._cond.notify_all()
+
+    @property
+    def frozen(self) -> bool:
+        return self._freeze_level > 0
+
+    def quiesce(self, timeout: float) -> bool:
+        """Wait (bounded) until every admitted window has fully drained:
+        nothing queued, nothing mid-dispatch, nothing awaiting the
+        resolver.  Returns True when idle was reached — the cutover
+        precondition; False means the budget expired with work still in
+        flight (the coordinator aborts rather than cutting over under
+        traffic)."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._cond:
+                idle = (
+                    not self._queue
+                    and self._pending_count == 0
+                    and self._inflight_windows == 0
+                    and self._resolve_q.empty()
+                )
+            if idle:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.001)
+
     def _shed_item(self, item: QueueItem, reason: str) -> None:
-        """Answer one shed submission (docs/overload.md).  Expired and
-        shutdown sheds answer a retriable per-item error so callers know
-        to retry with a fresh budget / against another peer; overflow
-        sheds answer the configured degradation policy (fail-open
-        UNDER_LIMIT with full remaining, fail-closed OVER_LIMIT with
-        zero remaining).  Columnar payloads release their arena lease
-        here — a shed batch must not pin a decode slab."""
+        """Answer one shed submission (docs/overload.md).  Expired,
+        shutdown and reshard sheds answer a retriable per-item error so
+        callers know to retry with a fresh budget / against another
+        peer / after the cutover; overflow sheds answer the configured
+        degradation policy (fail-open UNDER_LIMIT with full remaining,
+        fail-closed OVER_LIMIT with zero remaining).  Columnar payloads
+        release their arena lease here — a shed batch must not pin a
+        decode slab."""
         self.metric_shed_admission[reason] = (
             self.metric_shed_admission.get(reason, 0) + item.n)
         if self.metrics is not None:
             self.metrics.admission_shed.labels(reason=reason).inc(item.n)
-        retriable = reason in ("expired", "shutdown")
-        msg = SHED_EXPIRED_MSG if reason == "expired" else SHED_SHUTDOWN_MSG
+        retriable = reason in ("expired", "shutdown", "reshard")
+        if reason == "expired":
+            msg = SHED_EXPIRED_MSG
+        elif reason == "reshard":
+            msg = SHED_RESHARD_MSG
+        else:
+            msg = SHED_SHUTDOWN_MSG
         if item.kind == "obj":
             if retriable:
                 out = [RateLimitResponse(error=msg)

@@ -558,6 +558,7 @@ class Daemon:
         app.router.add_get("/debug/traces", self._h_debug_traces)
         app.router.add_get("/debug/state", self._h_debug_state)
         app.router.add_get("/debug/profile", self._h_debug_profile)
+        app.router.add_post("/debug/reshard", self._h_debug_reshard)
 
     async def _start_gateway(self) -> None:
         if not self.conf.http_listen_address:
@@ -815,7 +816,34 @@ class Daemon:
                 "base_writes": writer.metric_base_writes,
                 "write_failures": writer.metric_write_failures,
             }
+        body["reshard"] = inst.reshard_status()
         return web.json_response(body)
+
+    async def _h_debug_reshard(self, request: web.Request) -> web.Response:
+        """Admin trigger (docs/resharding.md): POST {"shards": m} runs
+        one n→m transition and answers its outcome dict.  409 when a
+        transition is already running; 400 on a bad target.  The debug
+        plane is operator-only (GUBER_DEBUG_ENDPOINTS), same trust level
+        as /debug/profile."""
+        if self.instance is None:
+            return web.json_response({"error": "starting up"}, status=503)
+        try:
+            doc = await request.json()
+            shards = int(doc["shards"])
+        except (ValueError, KeyError, TypeError):
+            return web.json_response(
+                {"error": "body must be JSON {\"shards\": <int>}"},
+                status=400,
+            )
+        from gubernator_tpu.parallel.reshard import ReshardError
+
+        try:
+            result = await self.instance.reshard(shards)
+        except ReshardError as e:
+            busy = "already running" in str(e)
+            return web.json_response(
+                {"error": str(e)}, status=409 if busy else 400)
+        return web.json_response(result)
 
     async def _h_debug_profile(self, request: web.Request) -> web.Response:
         try:

@@ -544,8 +544,58 @@ class Metrics:
             "gubernator_tpu_ownership_transfers",
             "GLOBAL keys whose accumulated state was handed to a new "
             "owning peer after a ring change; label \"result\" is "
-            "\"pushed\" (landed on the new owner) or \"requeued\" (push "
-            "failed; retried via the broadcast redelivery buffer).",
+            "\"pushed\" (landed on the new owner), \"requeued\" (push "
+            "failed; retried via the broadcast redelivery buffer), or "
+            "\"untracked\" (tracker at GUBER_REDELIVERY_LIMIT when the "
+            "key updated — its state will not ride a handoff).",
+            ["result"],
+            registry=reg,
+        )
+        # Elastic live resharding (docs/resharding.md): transition
+        # outcomes, the running transition's phase/size, verification
+        # counters gated at zero by the reshard_live bench rung, and the
+        # transition wall time.
+        self.reshard_transitions = Counter(
+            "gubernator_tpu_reshard_transitions",
+            "Reshard transitions by terminal outcome: \"committed\" (new "
+            "layout serving), \"aborted\" (rolled back to the old "
+            "layout), \"interrupted\" (a begin record with no terminal "
+            "record found at startup — the process died mid-transition "
+            "and restarted on the last snapshot).",
+            ["result"],
+            registry=reg,
+        )
+        self.reshard_phase = Gauge(
+            "gubernator_tpu_reshard_phase",
+            "Current reshard protocol phase: 0=idle, 1=freeze, 2=drain, "
+            "3=relayout, 4=cutover, 5=verify (returns to 0 on commit or "
+            "abort).",
+            registry=reg,
+        )
+        self.reshard_shards = Gauge(
+            "gubernator_tpu_reshard_shards",
+            "Serving shard count after the most recent committed "
+            "transition (the engine's live mesh width).",
+            registry=reg,
+        )
+        self.reshard_state_loss = Counter(
+            "gubernator_tpu_reshard_state_loss",
+            "Bucket rows live before a transition but missing from the "
+            "post-cutover table (verify phase). Must stay 0; gated at "
+            "ABSOLUTE_ZERO by the reshard_live bench rung.",
+            registry=reg,
+        )
+        self.reshard_double_served = Counter(
+            "gubernator_tpu_reshard_double_served",
+            "Keys resident on more than one shard after a cutover "
+            "(verify phase) — each is a potential double-serve. Must "
+            "stay 0; gated at ABSOLUTE_ZERO by the reshard_live rung.",
+            registry=reg,
+        )
+        self.reshard_duration = Summary(
+            "gubernator_tpu_reshard_duration",
+            "Wall time of one reshard transition (freeze through verify) "
+            "in seconds, by terminal outcome.",
             ["result"],
             registry=reg,
         )

@@ -52,6 +52,13 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   mesh_dropped_keys /            0   — every decision issued to the
 #   mesh_double_served                   sharded table resolves exactly
 #                                        once (issued == hits+misses)
+#   reshard_state_loss /           0   — an elastic n→m shard transition
+#   reshard_double_served                (docs/resharding.md) keeps every
+#                                        live bucket exactly once through
+#                                        the cutover
+#   reshard_parity_errors          0   — routed-path ownership agrees
+#                                        with the host ring on the
+#                                        post-transition layout
 #   expired_served                 0   — the overload rung's requests
 #                                        whose deadline passed before
 #                                        packing must be shed, never
@@ -90,6 +97,9 @@ COUNT_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "reshard_state_loss",
+    "reshard_double_served",
+    "reshard_parity_errors",
     "expired_served",
     "lease_over_admission",
     "lease_bucket_drift",
@@ -128,10 +138,16 @@ COUNT_KEYS = (
 #                           argument as loopback_p99_ms); a collapse
 #                           here means the bounded queue stopped
 #                           bounding queueing delay (docs/overload.md)
+#   reshard_p99_during_ms   p99 of client windows served while the
+#                           reshard_live rung's 8→4→8 transitions run —
+#                           lower is better, 1.5x slack (tail noise); a
+#                           blowup means the freeze/cutover window
+#                           stopped being bounded (docs/resharding.md)
 LOWER_BETTER_SLACK = {
     "serve_cpu_ms_per_batch": 1.3,
     "loopback_p99_ms": 1.5,
     "overload_admitted_p99_ms": 1.5,
+    "reshard_p99_during_ms": 1.5,
     "stage_decode_p99_ms": 1.5,
     "stage_pack_p99_ms": 1.5,
     "stage_h2d_p99_ms": 1.5,
@@ -223,6 +239,9 @@ ABSOLUTE_ZERO_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "reshard_state_loss",
+    "reshard_double_served",
+    "reshard_parity_errors",
     "expired_served",
     "lease_over_admission",
     "lease_bucket_drift",

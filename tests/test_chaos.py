@@ -252,6 +252,94 @@ async def test_chaos_intermittent_errors_recover_without_loss():
         await c.stop()
 
 
+async def test_chaos_peer_death_mid_reshard_defined_state():
+    """Reshard acceptance run (docs/resharding.md failure matrix): a peer
+    dies (100% partition) while a shard transition is requested.  The
+    open breaker aborts the transition *before* the cutover — a defined
+    state, zero bucket loss, zero double-serves — and admission
+    unfreezes so the daemon keeps serving.  Once the peer recovers the
+    same transition commits, the full protocol (freeze → drain →
+    journal → verify) runs on the live cluster, and the buffered GLOBAL
+    hits still redeliver with zero loss."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=31)
+    c = await Cluster.start(3, behaviors=behaviors, resilience=resilience,
+                            fault_injector=inj)
+    try:
+        name, key = "chaos-reshard", "rk"
+        owner = c.find_owning_daemon(name, key)
+        non_owner = c.list_non_owning_daemons(name, key)[0]
+        ni = c.daemons.index(non_owner)
+        owner_addr = owner.conf.grpc_listen_address
+        inj.set_fault(owner_addr, partition=True)
+
+        # Drive GLOBAL traffic into the dead owner until the breaker
+        # opens (metrics oracle) — this is the "peer died mid-transfer"
+        # precondition the coordinator must observe.
+        client = non_owner.client()
+        sent = 0
+        for _ in range(30):
+            out = await client.get_rate_limits([req(name, key)])
+            assert out[0].error == ""
+            sent += 1
+            await asyncio.sleep(0.005)
+        await client.close()
+        await c.wait_for_metric(
+            ni, "gubernator_breaker_transitions_total",
+            labels={"peerAddr": owner_addr, "to": "open"},
+        )
+
+        # The transition aborts on the open breaker, before any state
+        # moves: a defined outcome, never an exception.
+        res = await non_owner.instance.reshard(2)
+        assert res["outcome"] == "aborted"
+        assert "breaker" in res["reason"]
+        assert res["state_loss"] == 0 and res["double_served"] == 0
+        assert c.metric_value(
+            ni, "gubernator_tpu_reshard_transitions_total",
+            labels={"result": "aborted"},
+        ) == 1
+        # Admission unfroze: the daemon still answers (degraded, local).
+        assert not non_owner.instance.tick_loop.frozen
+        client = non_owner.client()
+        out = await client.get_rate_limits([req(name, key)])
+        assert out[0].error == ""
+        sent += 1
+        await client.close()
+
+        # Recovery: breaker closes, the same transition commits — the
+        # degenerate identity cutover runs the full freeze/drain/verify
+        # protocol on this single-chip engine.
+        inj.clear()
+        await c.wait_for_metric(
+            ni, "gubernator_breaker_transitions_total",
+            labels={"peerAddr": owner_addr, "to": "closed"},
+        )
+        before = non_owner.instance.engine.cache_size()
+        res = await non_owner.instance.reshard(2)
+        assert res["outcome"] == "committed"
+        assert res.get("degenerate") is True
+        assert res["state_loss"] == 0 and res["double_served"] == 0
+        assert res["live_items"] == before
+        assert c.metric_value(
+            ni, "gubernator_tpu_reshard_state_loss_total") == 0
+        assert c.metric_value(
+            ni, "gubernator_tpu_reshard_double_served_total") == 0
+        assert c.metric_value(
+            ni, "gubernator_tpu_reshard_transitions_total",
+            labels={"result": "committed"},
+        ) == 1
+
+        # The in-flight GLOBAL state rode through both transitions: every
+        # buffered hit redelivers to the recovered owner — zero loss,
+        # zero double-serves on the bucket itself.
+        await poll_consumed(owner, name, key, sent)
+        assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
 def _snapshot_daemon_conf(tmp_path, interval=0.05):
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",

@@ -246,3 +246,49 @@ def test_fault_injector_env_surface():
     assert inj.spec_for("10.0.0.3:81") is None
     # Unset → no injector in the hot path.
     assert conf_from({}).config.fault_injector is None
+
+
+def test_ssd_with_mesh_shards_is_config_error(tmp_path):
+    """Satellite robustness fix: SSD tier + sharded mesh engine is a
+    hard validation error, not warn+disable — a silently absent third
+    tier means the operator sized the deployment around capacity the
+    engine never had."""
+    env = {
+        "GUBER_SSD_DIR": str(tmp_path),
+        "GUBER_COLD_CACHE_SIZE": "100",
+        "GUBER_TPU_MESH_SHARDS": "2",
+    }
+    with pytest.raises(ValueError, match="sharded mesh engine"):
+        conf_from(env)
+    # Either alone is fine.
+    env.pop("GUBER_TPU_MESH_SHARDS")
+    assert conf_from(env).config.ssd_dir == str(tmp_path)
+    assert conf_from(
+        {"GUBER_TPU_MESH_SHARDS": "2"}).config.tpu_mesh_shards == 2
+
+
+def test_ssd_with_mesh_shards_rejected_at_engine_build(tmp_path):
+    """The same guard holds for programmatic InstanceConfig use (no
+    setup_daemon_config in the path)."""
+    from gubernator_tpu.service.instance import InstanceConfig, _make_engine
+
+    conf = InstanceConfig(
+        tpu_mesh_shards=2, ssd_dir=str(tmp_path), cold_cache_size=100,
+        tpu_platform="cpu",
+    )
+    with pytest.raises(ValueError, match="sharded mesh engine"):
+        _make_engine(conf)
+
+
+def test_reshard_knobs_defaults_and_overrides():
+    c = conf_from({})
+    assert c.config.reshard_freeze_timeout == pytest.approx(5.0)
+    assert c.config.reshard_verify is True
+    c = conf_from({
+        "GUBER_RESHARD_FREEZE_TIMEOUT": "500ms",
+        "GUBER_RESHARD_VERIFY": "0",
+    })
+    assert c.config.reshard_freeze_timeout == pytest.approx(0.5)
+    assert c.config.reshard_verify is False
+    with pytest.raises(ValueError, match="GUBER_RESHARD_FREEZE_TIMEOUT"):
+        conf_from({"GUBER_RESHARD_FREEZE_TIMEOUT": "0"})

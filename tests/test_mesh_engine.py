@@ -349,3 +349,110 @@ def test_mesh_store_read_through_for_spilled_rows():
     # Every response reflects the persisted remaining=3 minus this hit —
     # including the two spilled into the retry tick.
     assert [r.remaining for r in out] == [2, 2, 2, 2]
+
+
+# ----------------------------------------------------------------------
+# Elastic live resharding (docs/resharding.md)
+# ----------------------------------------------------------------------
+def test_layout_transition_spec_shard_counts():
+    """Pure-spec n→m remap parity at every interesting shard count —
+    including 1, odd, prime, and >8 (no engine builds).  The flat remap
+    ``owner*cap_to + local`` must be the identity on global slots (the
+    invariant that makes the on-device scatter lossless), owners must be
+    ``g // cap_to``, and every live slot must land exactly once."""
+    from gubernator_tpu.parallel.partition import plan_transition
+
+    for n_to in (1, 2, 3, 5, 7, 8, 13):
+        tr = plan_transition(8, 128, n_to)
+        assert tr.cap_to == -(-tr.live_slots // n_to)
+        rm = tr.remap()
+        assert rm.shape == (tr.live_slots, 3)
+        g = np.arange(tr.live_slots)
+        assert (rm[:, 0] == g // tr.cap_to).all(), n_to
+        assert (rm[:, 1] == g % tr.cap_to).all(), n_to
+        # Identity on flat slots == bijection: no loss, no double-serve.
+        assert (rm[:, 2] == g).all(), n_to
+        assert (rm[:, 0] < n_to).all() and (rm[:, 1] < tr.cap_to).all()
+
+
+def test_layout_transition_round_trip_identity():
+    """8→3→8 must be the identity transition: chaining through ``then``
+    threads the live-slot count, so the return leg re-derives the
+    original per-shard capacity and the composed remap is ``g → g``."""
+    from gubernator_tpu.parallel.partition import plan_transition
+
+    tr = plan_transition(8, 128, 3)
+    back = tr.then(8)
+    assert back.n_to == 8 and back.cap_to == 128
+    assert back.live_slots == tr.live_slots == 8 * 128
+    assert (back.remap()[:, 2] == np.arange(back.live_slots)).all()
+
+
+def test_layout_transition_validation():
+    from gubernator_tpu.parallel.partition import plan_transition
+
+    with pytest.raises(ValueError):
+        plan_transition(0, 128, 4)
+    with pytest.raises(ValueError):
+        plan_transition(8, 128, 0)
+    with pytest.raises(ValueError):
+        plan_transition(8, 0, 4)
+    with pytest.raises(ValueError):
+        plan_transition(8, 128, 4, live_slots=8 * 128 + 1)
+
+
+def test_relayout_dispatch_lossless_and_trace_stable(engine):
+    """Dispatching the relayout collective (no cutover) must produce a
+    flat table carrying every live row with identical state, and must
+    not retrace any warmed serving program — the transition runs its own
+    per-transition jit, never touching the serving widths."""
+    from gubernator_tpu.parallel.partition import plan_transition
+
+    engine.process([req(f"rl-{i}", limit=50) for i in range(40)], now=NOW)
+    before = {it["key"]: it for it in engine.export_items()}
+    traces = dict(engine.ops.trace_counts)
+    tr = plan_transition(engine.n_shards, engine.local_capacity,
+                         max(1, engine.n_shards // 2))
+    flat = engine._dispatch_relayout(tr)
+    items, n_live = engine._transition_items(flat)
+    assert n_live == len(before)
+    after = {it["key"]: it for it in items}
+    assert after.keys() == before.keys()
+    for k, it in after.items():
+        assert it["remaining"] == before[k]["remaining"], k
+        assert it["expire_at"] == before[k]["expire_at"], k
+    # Serving still on the old layout, and the relayout dispatch did not
+    # retrace any serving-width program (the satellite trace pin).
+    out = engine.process([req(f"rl-{i}", limit=50) for i in range(40)],
+                         now=NOW + 5)
+    assert all(r.error == "" for r in out)
+    now_traces = dict(engine.ops.trace_counts)
+    now_traces.pop("relayout", None)
+    traces.pop("relayout", None)
+    assert now_traces == traces
+
+
+@pytest.mark.slow
+def test_mesh_reshard_round_trip_under_state():
+    """Full 8→4→8 cutover on a dedicated engine: zero loss, value
+    parity, zero routing-parity errors, serving resumes on both sides.
+    Slow: each transition builds + warms a fresh shard set."""
+    eng = MeshTickEngine(
+        mesh=make_mesh(jax.devices()), local_capacity=64, max_batch=64
+    )
+    reqs = [req(f"rs-{i}", limit=100, duration=3_600_000)
+            for i in range(150)]
+    for s in range(0, len(reqs), 50):
+        eng.process(reqs[s:s + 50], now=NOW)
+    keys = sorted(it["key"] for it in eng.export_items())
+    info = eng.reshard(4, now=NOW + 10)
+    assert info["live_items"] == len(keys) and eng.n_shards == 4
+    assert sorted(it["key"] for it in eng.export_items()) == keys
+    assert eng.routing_parity_errors(keys) == 0
+    out = eng.process(reqs[:20], now=NOW + 20)
+    assert all(r.error == "" for r in out)
+    info = eng.reshard(8, now=NOW + 30)
+    assert info["to_shards"] == 8
+    assert sorted(it["key"] for it in eng.export_items()) == keys
+    assert eng.routing_parity_errors(keys) == 0
+    assert eng.reshard(8, now=NOW + 40)["noop"] is True

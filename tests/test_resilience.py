@@ -641,3 +641,41 @@ async def test_forward_global_degrades_to_local_on_open_breaker():
         assert r.hash_key() in inst.global_mgr._hits
     finally:
         await inst.close()
+
+
+async def test_owned_tracker_overflow_counted_not_silent(caplog):
+    """Owner-side GLOBAL key tracking past GUBER_REDELIVERY_LIMIT must
+    never be silent: the excess keys (which will NOT ride a ring-swap
+    handoff) are counted under ownership_transfers{result="untracked"}
+    and logged — at reshard scale a quietly lossy tracker re-creates the
+    ownership-migration bug the handoff machinery exists to prevent."""
+    import logging
+
+    metrics = Metrics()
+    peer = FailingPeer()
+    mgr = GlobalManager(
+        FakeInstance(peer),
+        BehaviorConfig(global_sync_wait=60.0),
+        metrics,
+        resilience=ResilienceConfig(redelivery_limit=3),
+    )
+    try:
+        with caplog.at_level(logging.WARNING, logger="gubernator.global"):
+            for i in range(5):
+                mgr.queue_update(
+                    req(key=f"ov-{i}", behavior=Behavior.GLOBAL))
+        assert len(mgr._owned) == 3                  # bounded
+        assert len(mgr._updates) == 5                # broadcast unaffected
+        assert metrics.sample(
+            "gubernator_tpu_ownership_transfers_total",
+            {"result": "untracked"}) == 2
+        assert any("ownership tracker full" in r.message
+                   for r in caplog.records)
+        # A key already tracked keeps updating in place at the cap.
+        mgr.queue_update(req(key="ov-0", hits=2, behavior=Behavior.GLOBAL))
+        assert len(mgr._owned) == 3
+        assert metrics.sample(
+            "gubernator_tpu_ownership_transfers_total",
+            {"result": "untracked"}) == 2
+    finally:
+        await mgr.close()

@@ -526,11 +526,15 @@ def test_repo_hot_path_markers_present():
         # The sharded serving path: resolve + both dispatch formats
         # (device-routed flat and host-blocked fallback) all run per
         # serving window.
+        # _dispatch_relayout/_cutover are the reshard transition's
+        # bounded window (docs/resharding.md): every serving window is
+        # frozen behind them, so G001 keeps them sync- and I/O-free.
         "gubernator_tpu/parallel/mesh_engine.py": [
             "submit_columns", "submit_cols", "submit",
             "_gregorian_cols", "_resolve_columns",
             "_resolve_columns_locked", "_account_misses",
-            "_dispatch_routed", "_dispatch_blocked"],
+            "_dispatch_routed", "_dispatch_blocked",
+            "_dispatch_relayout", "_cutover"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
         # Overload control plane (docs/overload.md): queue admission,
         # window pops, and limiter feedback all run per serving window.
