@@ -2292,9 +2292,9 @@ def rung_engine_leases():
 # ----------------------------------------------------------------------
 def child_mesh_tick():
     """Runs in the subprocess: MeshTickEngine over an 8-device mesh —
-    the multi-chip WorkerPool analog, on the device-routed serving path
-    (one flat slot-sorted batch per tick, each shard compacts its own
-    rows on device, responses gathered with one psum).
+    the multi-chip WorkerPool analog, on the ragged flat serving path
+    (one slot-sorted batch + extent offsets per tick, each shard walks
+    only its own extent on device, responses gathered with one psum).
 
     Exports the scaling story and the exact-work invariants the CI gate
     holds (scripts/check_bench_regression.py):
@@ -2323,10 +2323,9 @@ def child_mesh_tick():
     window_ids = [rng.permutation(n_keys) for _ in range(4)]
     windows = [_cols(ids, 1_000_000, 3_600_000, 0) for ids in window_ids]
 
-    def run(devs, routing):
+    def run(devs):
         eng = MeshTickEngine(
             mesh=make_mesh(devs), local_capacity=1 << 13, max_batch=batch,
-            routing=routing,
         )
         for c in windows:  # warm/compile + make all keys known
             eng.process_columns(c, now=now)
@@ -2346,12 +2345,10 @@ def child_mesh_tick():
         resolved = (eng.metric_hits - h0) + (eng.metric_misses - m0)
         return eng, done / dt, done, resolved
 
-    eng1, rate1, _, _ = run(jax.devices()[:1], "device")
+    eng1, rate1, _, _ = run(jax.devices()[:1])
     del eng1  # release each table before building the next
-    engh, rate_host, _, _ = run(jax.devices(), "host")
-    del engh
     n_nodes = len(jax.devices())
-    eng8, rate8, done8, resolved8 = run(jax.devices(), "device")
+    eng8, rate8, done8, resolved8 = run(jax.devices())
     work_delta = resolved8 - done8
     sample = ["bench_" + str(i) for i in range(n_keys)]
     print(
@@ -2362,11 +2359,6 @@ def child_mesh_tick():
                 "batch": batch,
                 "decisions_per_sec": round(rate8, 1),
                 "decisions_per_sec_1dev": round(rate1, 1),
-                "decisions_per_sec_host_routing": round(rate_host, 1),
-                # On-device routing vs the round-5 host-blocked packer,
-                # same mesh/shape — the win demonstrable on this venue.
-                "routed_vs_host_routing": round(
-                    rate8 / max(rate_host, 1e-9), 3),
                 # 8-dev vs ideal 8 x 1-dev.  NOTE the venue: the 8
                 # "devices" are XLA CPU virtual devices time-slicing ONE
                 # host core, so the physical ceiling here is 1/shards
@@ -2385,6 +2377,103 @@ def child_mesh_tick():
                 "routed_windows": eng8.metric_routed_windows,
                 "routed_overflows": eng8.metric_routed_overflows,
                 "layout": eng8.layout,
+                "backend": "cpu-8dev",
+            }
+        )
+    )
+
+
+def child_mesh_zipf():
+    """Runs in the subprocess: the ragged dispatch under Zipf-1.2
+    traffic over an 8-device mesh — the skew regime that used to
+    overflow the routed path's per-shard width and fall back to
+    host-blocked packing.  The ragged extent walk has no width, so the
+    skewed window IS the fast path.
+
+    Exports the ragged acceptance gates
+    (scripts/check_bench_regression.py):
+
+      mesh_routed_overflows       pinned-zero canary — the retired
+                                  fallback must never fire
+                                  (ABSOLUTE_ZERO)
+      mesh_ragged_parity_errors   decision mismatches vs a single-chip
+                                  TickEngine replaying the same traffic
+                                  (ABSOLUTE_ZERO)
+      mesh_trace_retraces         ShardedOps.trace_counts growth during
+                                  serving — every window reuses the one
+                                  warmup-compiled program per variant
+                                  (ABSOLUTE_ZERO)
+    """
+    jax.config.update("jax_platforms", "cpu")
+    from gubernator_tpu.ops.engine import TickEngine, resolve_ticks
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+
+    batch = 1024
+    n_keys = 1 << 12
+    now = 1_700_000_000_000
+    iters = 5 if FAST else 20
+    rng = np.random.default_rng(7)
+    # Zipf 1.2 ids (rung_kernel_zipf's traffic shape): a handful of ids
+    # dominate every window, so per-shard extents are maximally skewed.
+    window_ids = [
+        np.minimum(rng.zipf(1.2, batch) - 1, n_keys - 1)
+        for _ in range(4)
+    ]
+    windows = [_cols(ids, 1_000_000, 3_600_000, 0) for ids in window_ids]
+
+    eng = MeshTickEngine(
+        mesh=make_mesh(jax.devices()), local_capacity=1 << 13,
+        max_batch=batch,
+    )
+    for c in windows:  # warm/compile + make all keys known
+        eng.process_columns(c, now=now)
+    trace0 = dict(eng.ops.trace_counts)
+    t0 = time.perf_counter()
+    done = 0
+    pending = []
+    for i in range(iters):
+        c = windows[i % len(windows)]
+        pending.extend(eng.submit_cols(c, now=now + 1 + i).handles())
+        done += len(c)
+        if len(pending) >= 16:
+            resolve_ticks(pending)
+            pending.clear()
+    resolve_ticks(pending)
+    dt = time.perf_counter() - t0
+    retraces = sum(
+        eng.ops.trace_counts[k] - trace0.get(k, 0)
+        for k in eng.ops.trace_counts
+    )
+
+    # Parity reference: a single-chip TickEngine replays the identical
+    # schedule — warmup AND the timed loop, so both tables carry the
+    # same hit history — then per-request decisions must match exactly
+    # (the mesh path only re-partitions the table; duplicate
+    # sequencing, window arithmetic, and over_limit cuts are the same
+    # math).
+    ref = TickEngine(capacity=8 << 13, max_batch=batch)
+    for c in windows:
+        ref.process_columns(c, now=now)
+    for i in range(iters):
+        ref.process_columns(windows[i % len(windows)], now=now + 1 + i)
+    parity_errors = 0
+    for i in range(iters):
+        c = windows[i % len(windows)]
+        got, _ = eng.process_columns(c, now=now + 10_000 + i)
+        want, _ = ref.process_columns(c, now=now + 10_000 + i)
+        parity_errors += int((got != want).sum())
+    print(
+        json.dumps(
+            {
+                "rung": "mesh_zipf_8",
+                "shards": len(jax.devices()),
+                "batch": batch,
+                "decisions_per_sec": round(done / dt, 1),
+                "mesh_routed_overflows": int(eng.metric_routed_overflows),
+                "mesh_ragged_parity_errors": int(parity_errors),
+                "mesh_trace_retraces": int(retraces),
+                "routed_windows": eng.metric_routed_windows,
+                "layout": eng.layout,
                 "backend": "cpu-8dev",
             }
         )
@@ -2437,7 +2526,6 @@ def child_reshard_live():
 
     eng = MeshTickEngine(
         mesh=make_mesh(), local_capacity=1 << 9, max_batch=window,
-        routing="device",
     )
     loop = TickLoop(eng, batch_limit=window)
     coord = ReshardCoordinator(eng, tick_loop=loop, freeze_timeout=60.0,
@@ -2891,6 +2979,10 @@ def rung_mesh_tick():
     return _run_child("--child-mesh-tick", "mesh_tick_8")
 
 
+def rung_mesh_zipf():
+    return _run_child("--child-mesh-zipf", "mesh_zipf_8")
+
+
 def rung_reshard_live():
     # Two full transitions (each pays a fresh shard-set build + warmup
     # on the CPU venue) under a live driver thread; give the child room.
@@ -3072,6 +3164,7 @@ def main():
     ladder.append(_safe("chaos_redelivery", rung_chaos))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
+    ladder.append(_safe("mesh_zipf_8", rung_mesh_zipf))
     ladder.append(_safe("reshard_live", rung_reshard_live))
     ladder.append(_safe("mesh_100m_multichip", rung_mesh_100m))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
@@ -3248,6 +3341,12 @@ def compact_headline(record, ladder_file):
         # efficiency is direction-aware (must not decay vs baseline).
         "mesh_routing_parity_errors", "mesh_dropped_keys",
         "mesh_double_served", "mesh_scaling_efficiency",
+        # Ragged-dispatch gates (docs/tpu-performance.md round 15): the
+        # retired skew fallback is a pinned-zero canary, decision parity
+        # vs a single-chip replay is exact, and serving never retraces
+        # past the warmup-compiled programs.
+        "mesh_routed_overflows", "mesh_ragged_parity_errors",
+        "mesh_trace_retraces",
         # Elastic resharding gates (docs/resharding.md): zero bucket loss
         # and zero double-residency through an n->m cutover are
         # ABSOLUTE_ZERO, client p99 through the transition is
@@ -3286,6 +3385,8 @@ if __name__ == "__main__":
         child_mesh_100m()
     elif "--child-mesh-tick" in sys.argv:
         child_mesh_tick()
+    elif "--child-mesh-zipf" in sys.argv:
+        child_mesh_zipf()
     elif "--child-reshard-live" in sys.argv:
         child_reshard_live()
     elif "--child-mesh" in sys.argv:

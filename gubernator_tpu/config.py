@@ -107,8 +107,8 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_MEMBERLIST_ADDRESS": "member-list discovery: bind address",
     "GUBER_MEMBERLIST_ADVERTISE_ADDRESS": "member-list: advertise address",
     "GUBER_MEMBERLIST_KNOWN_NODES": "member-list: seed nodes (comma list)",
-    "GUBER_MESH_LOCAL_WIDTH": "routed per-shard block lanes (0 = auto)",
-    "GUBER_MESH_ROUTING": "sharded-table key routing: auto/device/host",
+    "GUBER_MESH_LOCAL_WIDTH": "DEPRECATED routed-path width (warns; no-op)",
+    "GUBER_MESH_ROUTING": "sharded-table key routing: auto/device",
     "GUBER_METRIC_FLAGS": "optional collectors: os,golang",
     "GUBER_PEER_DISCOVERY_TYPE": "discovery pool: member-list/etcd/dns/k8s/none",
     "GUBER_PEER_PICKER": "peer picker implementation",
@@ -248,12 +248,14 @@ class Config:
     tpu_max_batch: int = 4096        # request columns per device tick
     tpu_mesh_shards: int = 0         # 0 = single-chip TickEngine; N = mesh
     # Sharded-table key routing (parallel/mesh_engine.py): "device" (the
-    # "auto" default) ships one flat slot-sorted batch and each shard
-    # compacts its own rows on device; "host" keeps the legacy blocked
-    # per-shard packing.  GUBER_MESH_ROUTING
+    # "auto" default) ships one flat slot-sorted batch plus ragged
+    # extent offsets and each shard walks only its own extent on
+    # device.  The legacy "host" blocked packer is retired (the ragged
+    # path has no per-shard width to overflow).  GUBER_MESH_ROUTING
     mesh_routing: str = "auto"
-    # Per-shard lanes of the device-routed local block (0 = auto:
-    # ~batch/shards with headroom).  GUBER_MESH_LOCAL_WIDTH
+    # DEPRECATED: per-shard lanes of the retired device-routed local
+    # block.  The ragged dispatch has no width knob; a non-zero value
+    # only emits a one-time deprecation warning.  GUBER_MESH_LOCAL_WIDTH
     mesh_local_width: int = 0
     tpu_platform: str = ""           # force jax platform ("cpu" for tests)
     # Bucket-table storage: "auto" picks the Pallas row layout on TPU for
@@ -649,10 +651,10 @@ def setup_daemon_config(
             f"GUBER_TPU_BG_RECLAIM must be auto, on, or off; "
             f"got {conf.tpu_bg_reclaim!r}"
         )
-    if conf.mesh_routing not in ("auto", "device", "host"):
+    if conf.mesh_routing not in ("auto", "device"):
         raise ValueError(
-            f"GUBER_MESH_ROUTING must be auto, device, or host; "
-            f"got {conf.mesh_routing!r}"
+            f"GUBER_MESH_ROUTING must be auto or device (the legacy "
+            f"'host' blocked path is retired); got {conf.mesh_routing!r}"
         )
     if conf.mesh_local_width < 0:
         raise ValueError(

@@ -400,17 +400,36 @@ class StagingRing:
     buffer for the in-flight copy), and when every slab is in flight
     the lease falls back to a fresh allocation rather than corrupting
     one.  Callers hold their engine lock around lease()/retire() (ring
-    state is unsynchronized)."""
+    state is unsynchronized).
+
+    ``width`` picks between the two slab regimes:
+
+    * ``width=None`` (single-chip ``TickEngine``): slabs materialize
+      lazily per leased width — the engine quantizes batch sizes to a
+      small width ladder, so the dict stays a handful of entries.
+    * ``width=B`` (sharded mesh engine): ONE ring of ``(rows, B)``
+      slabs preallocated up front.  The ragged dispatch always leases
+      the full batch capacity — extent offsets, not slab shape, carry
+      the per-window size — so there is exactly one slab shape, one
+      H2D signature, one traced program."""
 
     __slots__ = ("rows", "sentinel", "depth", "_stage", "_next", "_leased",
                  "metric_leases", "metric_fallback_allocs")
 
-    def __init__(self, rows: int, sentinel: int, depth: int):
+    def __init__(self, rows: int, sentinel: int, depth: int,
+                 width: Optional[int] = None):
         self.rows = int(rows)
         self.sentinel = int(sentinel)
         self.depth = int(depth)
         self._stage: Dict[int, list] = {}   # width -> [[matrix, handle]]
         self._next: Dict[int, int] = {}
+        if width is not None:
+            w = int(width)
+            self._stage[w] = [
+                [np.empty((self.rows, w), np.int32), None]
+                for _ in range(self.depth)
+            ]
+            self._next[w] = 0
         self._leased: Optional[list] = None
         # Plain-int telemetry (caller holds the engine lock): total
         # leases and how many missed the ring entirely (every slab

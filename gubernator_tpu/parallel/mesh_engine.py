@@ -16,42 +16,43 @@ enter only on the GLOBAL-behavior reconciliation path (the GLOBAL mesh
 engine), matching how the reference keeps its hot loop local and
 reconciles asynchronously (``global.go``).
 
-Every device-side operation — tick, evict, install, restore, readback —
-runs as the same per-shard blocked ``shard_map``: the host builds one
-block per shard (padding rows aim at the shard's local guard/sentinel) and
-each device applies its block to its own slice.  Because the blocks reuse
-the single-chip ops (`make_tick_fn` etc.) per shard, the mesh engine
+Maintenance operations — evict, install, restore, readback — run as
+per-shard blocked ``shard_map``s: the host builds one block per shard
+(padding rows aim at the shard's local guard/sentinel) and each device
+applies its block to its own slice.  Because the blocks reuse the
+single-chip ops (`make_tick_fn` etc.) per shard, the mesh engine
 supports BOTH table layouts: the int32-column SoA and the Pallas
 row-DMA layout (rowtable.py) — the row layout's ~6-8x tick speedup is not
 forfeited by going multi-chip.
 
-**On-device routing (the serving default).**  Keys are strings, so
-hashing and the key→slot map stay host-side (SURVEY.md §7 "Host/device
-split") — but everything else the round-5 engine did per shard
-(regrouping the batch, packing one (19, W) block per shard, bookkeeping
-each request's (shard, lane)) is gone from the host: the tick ships ONE
-flat slot-sorted (19, B) compact matrix carrying GLOBAL slots, and each
-device derives its own rows from the slot value alone
-(``slot // local_capacity``; :func:`partition.route_block`), compacts
-them into a narrow (19, local_width) local block, ticks its shard, and
-scatters its responses back to flat lanes — gathered collectively with
-one ``psum`` (:func:`partition.scatter_flat`).  ``local_width`` ≈ B/n
-with headroom is the scaling lever: per-shard tick cost shrinks with
-the shard count at constant batch, host packing is O(B) regardless of
-n, and the upload reuses the single-chip engine's staging-ring/async-
-H2D pipeline (ops.engine.StagingRing) so window N+1's transfer rides
-under window N's tick.  Windows whose per-shard row count exceeds
-``local_width`` (adversarial hash skew — the host knows the counts
-before dispatch) fall back to the legacy host-blocked format for that
-tick, which also remains available wholesale as ``routing="host"``.
-All PartitionSpecs come from :mod:`gubernator_tpu.parallel.partition`,
-the canonical spec helper both mesh engines share.
+**Ragged on-device dispatch (the only tick wire format).**  Keys are
+strings, so hashing and the key→slot map stay host-side (SURVEY.md §7
+"Host/device split") — but everything else is gone from the host: the
+tick ships ONE flat slot-sorted (19, B) compact matrix carrying GLOBAL
+slots plus a ``(n_shards + 1,)`` cumulative offsets vector
+(:class:`partition.RaggedExtents` — the host already knows the
+per-shard counts from the resolve), and each device walks only its own
+``[offsets[my], offsets[my+1])`` extent of the flat matrix
+(ops.raggedtick): no per-shard compaction into a padded
+``local_width`` block, no skew fallback, one fixed-shape program per
+batch capacity.  The flat batch sorts by GLOBAL slot and ownership is
+``slot // local_capacity``, so each shard's rows are contiguous by
+construction; responses merge into zeroed flat lanes per shard and
+gather collectively with one exact ``psum``.  Adversarially skewed
+windows (every key on one shard) run MORE ITERATIONS of the same
+compiled extent walk — ``metric_routed_overflows`` stays wired as a
+pinned-zero canary.  The upload reuses the single-chip engine's
+staging-ring/async-H2D pipeline (ops.engine.StagingRing, single slab
+shape) so window N+1's transfer rides under window N's tick.  All
+PartitionSpecs come from :mod:`gubernator_tpu.parallel.partition`, the
+canonical spec helper both mesh engines share.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import warnings
 import zlib
 from typing import Dict, List, Optional, Sequence
 
@@ -77,7 +78,6 @@ from gubernator_tpu.ops.engine import (
     StagingRing,
     device_dead_mask,
     items_from_columns,
-    join_i32_pair,
     make_evict_fn,
     make_install_fn,
     make_layout_choice,
@@ -90,18 +90,20 @@ from gubernator_tpu.ops.engine import (
     pad_pow2,
     select_reclaim_victims,
     sort_packed_by_slot,
-    split_i64,
     unpack_resp_compact,
+)
+from gubernator_tpu.ops.raggedtick import (
+    choose_tile,
+    make_fused_ragged_tick_fn,
+    ragged_walk,
 )
 from gubernator_tpu.parallel.partition import (
     LayoutTransition,
+    RaggedExtents,
     ShardLayout,
     plan_transition,
     relayout_block,
-    route_block,
-    scatter_flat,
 )
-from gubernator_tpu.ops.reqcols import CREATED_UNSET
 from gubernator_tpu.ops.rowtable import ROW_W, RowState
 from gubernator_tpu.types import (
     Behavior, GlobalUpdate, RateLimitRequest, RateLimitResponse)
@@ -115,23 +117,46 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), ("shard",))
 
 
+_LOCAL_WIDTH_WARNED = False
+
+
+def _warn_local_width_deprecated() -> None:
+    """One-time (per process) deprecation warning for the dead
+    ``local_width`` / ``GUBER_MESH_LOCAL_WIDTH`` knob: the ragged
+    extent walk has no per-shard width to bound, so the value is
+    ignored.  The ENV_REGISTRY entry stays until removal (G004)."""
+    global _LOCAL_WIDTH_WARNED
+    if _LOCAL_WIDTH_WARNED:
+        return
+    _LOCAL_WIDTH_WARNED = True
+    warnings.warn(
+        "GUBER_MESH_LOCAL_WIDTH / MeshTickEngine(local_width=...) is "
+        "deprecated and ignored: the ragged tick dispatch walks each "
+        "shard's extent directly and has no per-shard width limit",
+        DeprecationWarning,
+        stacklevel=3,
+    )
+
+
 class ShardedOps:
     """The per-shard device ops for one (mesh, local_capacity, layout):
     tick/evict/install/restore/readback, each a shard_map of the
-    corresponding single-chip op, jitted with state donation.  Ticks come
-    in two wire formats — the legacy host-blocked (n_shards, 19, W) and
-    the device-routed flat (19, B) (module docstring) — built from the
-    same per-shard tick closures.
+    corresponding single-chip op, jitted with state donation.  Ticks use
+    ONE wire format — the ragged flat (19, B) + offsets dispatch (module
+    docstring) — in two programs: the merge-capable x64 extent walker
+    for duplicate-bearing windows and the duplicate-free parts program
+    (the fused Pallas ragged kernel on the row layout).
 
     ``trace_counts`` increments once per TRACE of each program (the
     counter bump runs at trace time only): serving re-dispatch must hit
     the warmed executables, and tests pin the counts so a signature
     drift between warmup and serving (e.g. a committed ``device_put``
     where warmup used ``jnp.asarray``) fails loudly instead of silently
-    re-tracing per tick."""
+    re-tracing per tick — with the ragged wire there is exactly one
+    program per batch capacity, so ANY skew- or width-driven growth of
+    these counters is a regression."""
 
-    def __init__(self, mesh: Mesh, local_capacity: int, layout: str,
-                 local_width: int = 0):
+    def __init__(self, mesh: Mesh, local_capacity: int, layout: str):
         self.mesh = mesh
         self.layout = layout
         self.local_capacity = local_capacity
@@ -180,35 +205,35 @@ class ShardedOps:
                 donate_argnums=(0,),
             )
 
-        def _tick(state_blk, req_blk, now):
-            self.trace_counts["tick"] += 1
-            st, resp = tick(state_blk, req_blk[0], now)
-            return st, resp[None]
+        # ---- Ragged flat tick programs (module docstring): one
+        # replicated slot-sorted (19, B) batch plus the (n_shards + 1,)
+        # extent offsets in; each shard walks only its own
+        # [offsets[my], offsets[my+1]) extent of the flat matrix
+        # (ops.raggedtick) and the responses gather with one psum.
+        n_shards = n
 
-        self.tick = smap(
-            _tick,
-            (state_spec, P("shard", None, None), P()),
-            (state_spec, P("shard", None, None)),
-        )
+        def _extent(offsets, my):
+            start = offsets[my]
+            count = offsets[my + 1] - start
+            lo = my.astype(jnp.int32) * local_capacity
+            return start, count, lo
 
-        # ---- Device-routed flat programs (module docstring): one
-        # replicated slot-sorted (19, B) batch in, each shard compacts
-        # its own rows to a narrow (19, local_width) block on device,
-        # and the responses gather collectively with one psum.
-        self.local_width = int(local_width) or local_capacity
-
-        def _tick_routed(state_blk, m, now):
-            self.trace_counts["tick_routed"] += 1
+        def _tick_ragged(state_blk, m, offsets, now):
+            self.trace_counts["tick_ragged"] += 1
             my = lax.axis_index("shard")
-            blk, src = route_block(m, my, local_capacity, self.local_width)
-            st, resp = tick(state_blk, blk, now)
-            out = scatter_flat(resp, src, m.shape[1])
+            start, count, lo = _extent(offsets, my)
+            st, out = ragged_walk(
+                lambda s_, blk: tick(s_, blk, now),
+                state_blk, m, start, count, lo, local_capacity,
+                choose_tile(m.shape[1], n_shards),
+                jnp.zeros((6, m.shape[1]), jnp.int32),
+            )
             return st, lax.psum(out, "shard")
 
-        flat_in = (state_spec, lay.flat2(), lay.scalar())
-        self.tick_routed = jax.jit(
+        flat_in = (state_spec, lay.flat2(), lay.offsets1(), lay.scalar())
+        self.tick_ragged = jax.jit(
             shard_map(
-                _tick_routed, mesh=mesh, in_specs=flat_in,
+                _tick_ragged, mesh=mesh, in_specs=flat_in,
                 out_specs=(state_spec, lay.flat2()), check_vma=False,
             ),
             donate_argnums=(0,),
@@ -218,85 +243,67 @@ class ShardedOps:
         # production common case): host-dispatched as its OWN program —
         # not a traced lax.cond next to the x64 tick — so the row layout
         # keeps the fused Mosaic kernel per shard (Mosaic refuses x64
-        # traces; tick32 module doc).  The unfused variant returns its
-        # six response rows unstacked (CPU concat-fusion pathology) and
-        # stack6 reassembles the (shard, 6, B) block in its own program.
+        # traces; tick32 module doc).  The fused ragged kernel walks the
+        # extent inside the kernel (runtime chunk count); the unfused
+        # variant tiles it with ragged_walk and returns its six response
+        # rows unstacked (CPU concat-fusion pathology), stack6_ragged
+        # reassembling the (6, B) matrix in its own program.
         from gubernator_tpu.ops.tick32 import (
-            _resolve_fused, make_tick32_fn, make_tick32_rows_fn)
+            _resolve_fused, make_tick32_rows_fn)
 
         self._fused32 = layout == "row" and _resolve_fused(None)
         if self._fused32:
-            tick32 = make_tick32_fn(local_capacity, layout)
+            fused_ragged = make_fused_ragged_tick_fn(local_capacity)
 
-            def _tick32(state_blk, req_blk, now):
-                self.trace_counts["tick_unique"] += 1
-                st, resp = tick32(state_blk, req_blk[0], now)
-                return st, resp[None]
-
-            self.tick_unique = smap(
-                _tick32,
-                (state_spec, P("shard", None, None), P()),
-                (state_spec, P("shard", None, None)),
-            )
-            self.stack6 = None
-
-            def _tick32_routed(state_blk, m, now):
-                self.trace_counts["tick_unique_routed"] += 1
+            def _tick32_ragged(state_blk, m, offsets, now):
+                self.trace_counts["tick_unique_ragged"] += 1
                 my = lax.axis_index("shard")
-                blk, src = route_block(
-                    m, my, local_capacity, self.local_width)
-                st, resp = tick32(state_blk, blk, now)
-                return st, lax.psum(
-                    scatter_flat(resp, src, m.shape[1]), "shard")
+                start, count, lo = _extent(offsets, my)
+                st, resp = fused_ragged(
+                    state_blk, m, start, count, lo, now)
+                return st, lax.psum(resp, "shard")
 
-            self.tick_unique_routed = jax.jit(
+            self.tick_unique_ragged = jax.jit(
                 shard_map(
-                    _tick32_routed, mesh=mesh, in_specs=flat_in,
+                    _tick32_ragged, mesh=mesh, in_specs=flat_in,
                     out_specs=(state_spec, lay.flat2()), check_vma=False,
                 ),
                 donate_argnums=(0,),
             )
-            self.stack6_routed = None
+            self.stack6_ragged = None
         else:
             tick32_rows = make_tick32_rows_fn(local_capacity, layout)
 
-            def _tick32(state_blk, req_blk, now):
-                self.trace_counts["tick_unique"] += 1
-                st, rows = tick32_rows(state_blk, req_blk[0], now)
-                return st, tuple(r[None] for r in rows)
-
-            self.tick_unique = smap(
-                _tick32,
-                (state_spec, P("shard", None, None), P()),
-                (state_spec, tuple(P("shard", None) for _ in range(6))),
-            )
-            self.stack6 = jax.jit(lambda rows: jnp.stack(rows, axis=1))
-
-            def _tick32_routed(state_blk, m, now):
-                self.trace_counts["tick_unique_routed"] += 1
+            def _tick32_ragged(state_blk, m, offsets, now):
+                self.trace_counts["tick_unique_ragged"] += 1
                 my = lax.axis_index("shard")
-                blk, src = route_block(
-                    m, my, local_capacity, self.local_width)
-                st, rows = tick32_rows(state_blk, blk, now)
+                start, count, lo = _extent(offsets, my)
                 b = m.shape[1]
-                return st, tuple(
-                    lax.psum(scatter_flat(r, src, b), "shard")
-                    for r in rows
-                )
 
-            self.tick_unique_routed = jax.jit(
+                def tile_tick(s_, blk):
+                    s2, rows = tick32_rows(s_, blk, now)
+                    return s2, tuple(rows)
+
+                st, rows = ragged_walk(
+                    tile_tick, state_blk, m, start, count, lo,
+                    local_capacity, choose_tile(b, n_shards),
+                    tuple(jnp.zeros(b, jnp.int32) for _ in range(6)),
+                )
+                return st, tuple(lax.psum(r, "shard") for r in rows)
+
+            self.tick_unique_ragged = jax.jit(
                 shard_map(
-                    _tick32_routed, mesh=mesh, in_specs=flat_in,
+                    _tick32_ragged, mesh=mesh, in_specs=flat_in,
                     out_specs=(
                         state_spec, tuple(P(None) for _ in range(6))),
                     check_vma=False,
                 ),
                 donate_argnums=(0,),
             )
-            # Same second-program stack as the blocked path (stacking
-            # the six rows inside the tick hits the CPU concat-fusion
-            # pathology; see the blocked comment above).
-            self.stack6_routed = jax.jit(lambda rows: jnp.stack(rows, axis=0))
+            # Second-program stack, same as the single-chip engine
+            # (stacking the six rows inside the tick hits the CPU
+            # concat-fusion pathology; see make_tick32_rows_fn).
+            self.stack6_ragged = jax.jit(lambda rows: jnp.stack(rows, axis=0))
 
         def _evict(state_blk, slots_blk):
             return evict(state_blk, slots_blk[0])
@@ -343,21 +350,12 @@ class ShardedOps:
             self.state_shardings,
         )
 
-    def run_tick_unique(self, state, m_dev, now):
-        """Dispatch the duplicate-free tick; returns the (shard, 6, B)
-        response block whichever internal format the backend uses."""
-        state, out = self.tick_unique(state, m_dev, now)
-        if self.stack6 is not None:
-            out = self.stack6(out)
-        return state, out
-
-    def run_tick_routed_unique(self, state, m_dev, now):
-        """Dispatch the duplicate-free device-routed tick; returns the
-        flat (6, B) response whichever internal format the backend
-        uses."""
-        state, out = self.tick_unique_routed(state, m_dev, now)
-        if self.stack6_routed is not None:
-            out = self.stack6_routed(out)
+    def run_tick_ragged_unique(self, state, m_dev, offsets_dev, now):
+        """Dispatch the duplicate-free ragged tick; returns the flat
+        (6, B) response whichever internal format the backend uses."""
+        state, out = self.tick_unique_ragged(state, m_dev, offsets_dev, now)
+        if self.stack6_ragged is not None:
+            out = self.stack6_ragged(out)
         return state, out
 
     def put2(self, blk: np.ndarray):
@@ -367,68 +365,15 @@ class ShardedOps:
         return jax.device_put(blk, self.block_sharding3)
 
 
-class MeshTickHandle:
-    """One dispatched mesh tick: device work queued, host readback
-    deferred — duck-compatible with :class:`ops.engine.TickHandle` so
-    ``resolve_ticks`` can stack mesh and single-chip responses alike.
-
-    ``result()`` materializes the (5, n) response matrix in request
-    order (rows: status, limit, remaining, reset_time, over_limit)."""
-
-    __slots__ = ("_engine", "_resp", "_n", "_sh", "_ps", "errors",
-                 "_limit_req", "_wt_args", "_done")
-
-    def __init__(self, engine, resp, n, sh, ps, errors, limit_req, wt_args):
-        self._engine = engine
-        self._resp = resp
-        self._n = n
-        self._sh = sh
-        self._ps = ps          # per-request (shard, block position); -1 = error
-        self.errors = errors
-        # Copied: callers may reuse their ReqColumns buffers between
-        # submit and resolve (the pipelining pattern).
-        self._limit_req = np.array(limit_req[:n], np.int64, copy=True)
-        self._wt_args = wt_args
-        self._done: Optional[np.ndarray] = None
-
-    def _finish(self, raw: np.ndarray) -> None:
-        if self._done is not None:
-            return
-        n = self._n
-        ok = self._ps >= 0
-        shs = np.where(ok, self._sh, 0)
-        pss = np.where(ok, self._ps, 0)
-        out = np.empty((5, n), np.int64)
-        out[0] = raw[shs, 0, pss]
-        out[1] = self._limit_req
-        out[2] = join_i32_pair(raw[shs, 2, pss], raw[shs, 3, pss])
-        out[3] = join_i32_pair(raw[shs, 4, pss], raw[shs, 5, pss])
-        out[4] = raw[shs, 1, pss]
-        eng = self._engine
-        with eng._lock:
-            if self._done is not None:  # cross-thread race: run once
-                return
-            # error rows carry guard-row garbage: mask before counting
-            eng.metric_over_limit += int(out[4][ok].sum())
-            if eng.store is not None and self._wt_args is not None:
-                eng._write_through(*self._wt_args)
-            self._resp = None
-            self._done = out
-
-    def result(self):
-        if self._done is None:
-            self._finish(np.asarray(self._resp))
-        return self._done, self.errors
-
-
-class MeshRoutedTickHandle:
-    """One dispatched device-routed mesh tick: the flat (6, B) compact
+class MeshRaggedTickHandle:
+    """One dispatched ragged mesh tick: the flat (6, B) compact
     response is already in slot-sorted request-batch order (the shards'
-    psum gather put every lane back), so resolution is exactly the
-    single-chip ``TickHandle`` contract — un-permute, rebuild the public
-    (5, n) int64 matrix, run the deferred bookkeeping.  Duck-compatible
-    with ``ops.engine.resolve_ticks`` (same-shape responses stack into
-    one D2H)."""
+    extent walks merged every lane in place; the psum gather summed the
+    disjoint extents), so resolution is exactly the single-chip
+    ``TickHandle`` contract — un-permute, rebuild the public (5, n)
+    int64 matrix, run the deferred bookkeeping.  Duck-compatible with
+    ``ops.engine.resolve_ticks`` (same-shape responses stack into one
+    D2H)."""
 
     __slots__ = ("_engine", "_resp", "_n", "_inv", "errors", "_limit_req",
                  "_wt_args", "_done", "_flock")
@@ -480,13 +425,14 @@ class MeshTickEngine:
     Key→shard routing reuses the engine's slot allocator: global slot ``g``
     lives on shard ``g // local_capacity`` at local offset
     ``g % local_capacity`` — the ONE ownership rule, derived identically by
-    the host resolve and the on-device router (partition.route_block).
+    the host resolve and the on-device extent walker
+    (partition.RaggedExtents / ops.raggedtick).
 
-    ``routing`` selects the tick wire format: ``"device"`` (the ``"auto"``
-    default) ships one flat slot-sorted batch and lets each shard compact
-    its own rows on device; ``"host"`` keeps the legacy host-blocked
-    per-shard packing wholesale.  ``local_width`` bounds the routed
-    per-shard block (0 = auto: ~B/n with headroom, 64-lane quantized).
+    Every tick ships the ragged flat wire format (module docstring);
+    ``routing`` survives as a knob accepting ``"auto"``/``"device"``
+    only — the legacy ``"host"`` blocked path is gone.  ``local_width``
+    is dead (the ragged walk has no per-shard width to bound): a
+    non-zero value warns once and is otherwise ignored.
     """
 
     def __init__(
@@ -514,30 +460,24 @@ class MeshTickEngine:
             )
         self.max_batch = int(max_batch)
         self.store = store
-        if routing not in ("auto", "device", "host"):
-            raise ValueError(f"unknown mesh routing {routing!r}")
-        self.routing = "host" if routing == "host" else "device"
-        # As-configured knobs, kept verbatim so reshard() can re-derive
-        # the auto choices (layout fit, routed block width) for the new
-        # shard count instead of freezing this build's resolution.
+        if routing not in ("auto", "device"):
+            raise ValueError(
+                f"unknown mesh routing {routing!r} (the legacy 'host' "
+                "blocked path was removed; the ragged device dispatch "
+                "serves every window)")
+        self.routing = "device"
+        # As-configured layout knob, kept verbatim so reshard() can
+        # re-derive the auto choice (layout fit) for the new shard
+        # count instead of freezing this build's resolution.
         self._table_layout_conf = table_layout
-        self._local_width_conf = int(local_width)
-        if not local_width:
-            # ~B/n with 25% headroom for hash imbalance, 64-lane
-            # quantized; adversarially skewed windows fall back to the
-            # blocked format (metric_routed_overflows).
-            local_width = max(64, -(-5 * self.max_batch
-                                    // (4 * self.n_shards)))
-            local_width = -(-local_width // 64) * 64
-        self.local_width = min(int(local_width), self.max_batch)
+        if int(local_width):
+            _warn_local_width_deprecated()
         self.layout = make_layout_choice(
             table_layout, self.local_capacity,
             self.mesh.devices.flat[0], self.max_batch,
         )
-        self.ops = ShardedOps(
-            self.mesh, self.local_capacity, self.layout,
-            local_width=self.local_width,
-        )
+        self.ragged = RaggedExtents(self.n_shards, self.local_capacity)
+        self.ops = ShardedOps(self.mesh, self.local_capacity, self.layout)
         self.state = self.ops.init_state()
         # One slot allocator per shard; keys are routed to shards by hash,
         # the mesh analog of the reference's hash-range→worker routing
@@ -553,7 +493,8 @@ class MeshTickEngine:
         # Flat-upload staging ring + overlap telemetry (the PR 6
         # double-buffered H2D pipeline, shared via ops.engine.StagingRing;
         # sentinel is the GLOBAL capacity — flat padding lanes belong to
-        # no shard).
+        # no shard).  The ragged wire has exactly ONE slab shape
+        # (rows × max_batch), so the ring preallocates it up front.
         try:
             _depth = max(1, env_knob(
                 "GUBER_TICK_PIPELINE_DEPTH", 4, parse=int))
@@ -561,7 +502,8 @@ class MeshTickEngine:
             _depth = 4
         self._staging_slabs = 2 * _depth + 1
         self._staging = StagingRing(
-            REQ32_ROWS, self.capacity, self._staging_slabs)
+            REQ32_ROWS, self.capacity, self._staging_slabs,
+            width=self.max_batch)
         self._inflight = 0
         self.metric_h2d_windows = 0
         self.metric_h2d_overlapped = 0
@@ -575,39 +517,28 @@ class MeshTickEngine:
 
     def _warmup(self) -> None:
         """Compile the serving-path programs at startup (see
-        TickEngine._warmup).  Only the selected routing mode's tick pair
-        warms — the other mode compiles lazily on first use (the
-        blocked pair still serves as the routed path's skew fallback).
+        TickEngine._warmup): both ragged ticks — the merge-capable x64
+        extent walker and the duplicate-free parts program — with an
+        all-sentinel batch and empty extents (offsets all zero: the
+        walkers' dynamic trip counts are runtime values, so the empty
+        window compiles the same single program serving traffic uses).
         Warmup MUST dispatch with the exact serving signature:
-        ``jnp.asarray`` uploads (uncommitted), never a committed
-        ``device_put`` — a committed sharding is a new jit signature
-        that re-traces every warmed program (~0.6 s each; the
-        ShardedOps.trace_counts pin in test_mesh_engine holds this)."""
-        if self.routing == "device":
-            m = np.zeros((REQ32_ROWS, self.max_batch), np.int32)
-            m[REQ32_INDEX["slot"]] = self.capacity
-            self.state, resp = self.ops.tick_routed(
-                self.state, jnp.asarray(m), jnp.int64(0)
-            )
-            np.asarray(resp)  # warm the response D2H path
-            self.state, resp = self.ops.run_tick_routed_unique(
-                self.state, jnp.asarray(m), jnp.int64(0)
-            )
-            np.asarray(resp)
-        else:
-            mb = np.zeros((self.n_shards, REQ32_ROWS, self.max_batch),
-                          np.int32)
-            mb[:, REQ32_INDEX["slot"], :] = self.local_capacity
-            # Warm both programs: the merge-capable x64 tick and the
-            # duplicate-free parts tick.
-            self.state, resp = self.ops.tick(
-                self.state, self.ops.put3(mb), jnp.int64(0)
-            )
-            np.asarray(resp)
-            self.state, resp = self.ops.run_tick_unique(
-                self.state, self.ops.put3(mb), jnp.int64(0)
-            )
-            np.asarray(resp)
+        ``jnp.asarray`` uploads (uncommitted) for the matrix AND the
+        offsets vector, never a committed ``device_put`` — a committed
+        sharding is a new jit signature that re-traces every warmed
+        program (~0.6 s each; the ShardedOps.trace_counts pin in
+        test_mesh_engine holds this)."""
+        m = np.zeros((REQ32_ROWS, self.max_batch), np.int32)
+        m[REQ32_INDEX["slot"]] = self.capacity
+        offs = self.ragged.offsets(np.zeros(self.n_shards, np.int64))
+        self.state, resp = self.ops.tick_ragged(
+            self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
+        )
+        np.asarray(resp)  # warm the response D2H path
+        self.state, resp = self.ops.run_tick_ragged_unique(
+            self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
+        )
+        np.asarray(resp)
         cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
         self.state = self.ops.install(
             self.state, self.ops.put3(cols), jnp.int64(0)
@@ -844,10 +775,10 @@ class MeshTickEngine:
         the next tick overlaps device execution of this one
         (TickEngine.submit_columns's contract, sharded).
 
-        The resolve is shared; the wire format is per ``routing``: the
-        device-routed flat dispatch when every shard's row count fits
-        its ``local_width`` block, the host-blocked dispatch for skewed
-        windows and for ``routing="host"`` engines."""
+        Every window — skewed or not — takes the ragged flat dispatch:
+        the extent walk's trip counts are runtime values, so there is
+        no per-shard width to overflow and no fallback format
+        (``metric_routed_overflows`` stays a pinned-zero canary)."""
         n = len(cols)
         if n > self.max_batch:
             raise ValueError(
@@ -862,31 +793,24 @@ class MeshTickEngine:
             ok = slots >= 0
             for i in errors:
                 ok[i] = False
-            if self.routing == "device":
-                counts = np.bincount(
-                    sh[ok], minlength=self.n_shards
-                ) if ok.any() else np.zeros(self.n_shards, np.int64)
-                if counts.max(initial=0) <= self.local_width:
-                    return self._dispatch_routed(
-                        cols, now, sh, slots, known, ok,
-                        greg_e, greg_d, errors,
-                    )
-                self.metric_routed_overflows += 1
-            return self._dispatch_blocked(
+            return self._dispatch_ragged(
                 cols, now, sh, slots, known, ok, greg_e, greg_d, errors,
             )
 
     @hot_path
-    def _dispatch_routed(
+    def _dispatch_ragged(
         self, cols, now, sh, slots, known, ok, greg_e, greg_d, errors
-    ) -> "MeshRoutedTickHandle":
-        """The flat device-routed dispatch: pack ONE slot-sorted
-        (19, B) compact matrix carrying GLOBAL slots into a leased
-        staging slab, upload it with an async ``jnp.asarray`` copy (the
-        transfer rides under the previous window's tick; the uncommitted
-        signature matches warmup, so re-dispatch reuses the compiled
-        program), and let every shard compact its own rows on device —
-        no per-shard host loop, responses gathered with one psum."""
+    ) -> "MeshRaggedTickHandle":
+        """The ragged flat dispatch: pack ONE slot-sorted (19, B)
+        compact matrix carrying GLOBAL slots into a leased staging
+        slab, derive the per-shard extent offsets from the resolve's
+        counts (partition.RaggedExtents — the slot sort groups shards
+        contiguously in ascending order), and upload both with async
+        ``jnp.asarray`` copies (the transfer rides under the previous
+        window's tick; the uncommitted signatures match warmup, so
+        re-dispatch reuses the compiled program).  Each shard walks
+        only its own extent on device — no per-shard host loop, no
+        padded per-shard block, responses gathered with one psum."""
         n = len(cols)
         b = self.max_batch
         # Flight-recorder stage notes + named ranges/spans, mirroring the
@@ -903,20 +827,22 @@ class MeshTickEngine:
         pack_wide_rows(m, "greg_exp", greg_e[ix], ix)
         pack_wide_rows(m, "greg_dur", greg_d[ix], ix)
         inv, has_dups = sort_packed_by_slot(m, n, self.capacity)
+        offs = self.ragged.offsets(self.ragged.counts(sh, ok))
         if fr is not None:
             fr.note(fr.active(), "pack", time.perf_counter() - t0)
             t0 = time.perf_counter()
         with tracing.profile_annotation("guber.mesh.tick"), \
-                tracing.maybe_span("guber.mesh.dispatch_routed",
+                tracing.maybe_span("guber.mesh.dispatch_ragged",
                                    {"batch": n}):
             dev_m = jnp.asarray(m)
+            dev_offs = jnp.asarray(offs)
             if has_dups:
-                self.state, resp = self.ops.tick_routed(
-                    self.state, dev_m, jnp.int64(now)
+                self.state, resp = self.ops.tick_ragged(
+                    self.state, dev_m, dev_offs, jnp.int64(now)
                 )
             else:
-                self.state, resp = self.ops.run_tick_routed_unique(
-                    self.state, dev_m, jnp.int64(now)
+                self.state, resp = self.ops.run_tick_ragged_unique(
+                    self.state, dev_m, dev_offs, jnp.int64(now)
                 )
         if fr is not None:
             fr.note(fr.active(), "h2d", time.perf_counter() - t0)
@@ -925,7 +851,7 @@ class MeshTickEngine:
         wt_args = None
         if self.store is not None:
             wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
-        handle = MeshRoutedTickHandle(
+        handle = MeshRaggedTickHandle(
             self, resp, n, inv, errors, cols.limit, wt_args
         )
         self.metric_h2d_windows += 1
@@ -933,87 +859,6 @@ class MeshTickEngine:
             self.metric_h2d_overlapped += 1
         self._inflight += 1
         self._staging.retire(handle)
-        if self.store is not None:
-            handle.result()
-        return handle
-
-    @hot_path
-    def _dispatch_blocked(
-        self, cols, now, sh, slots, known, ok, greg_e, greg_d, errors
-    ) -> "MeshTickHandle":
-        """The legacy host-blocked dispatch: one argsort by
-        (shard, slot) establishes each shard's sorted-input contract,
-        every request-matrix row is one fancy-indexed numpy write into
-        the (n_shards, 19, W) block matrix, committed ``device_put``
-        places it per shard."""
-        n = len(cols)
-        resolved = slots >= 0
-        # Per-shard sorted-input contract: one argsort by
-        # (shard, slot); error rows sort to each shard's end.
-        safe_slots = np.where(resolved, slots, self.local_capacity)
-        key = sh * (self.local_capacity + 1) + safe_slots
-        order2 = np.argsort(key, kind="stable")
-        sh2 = sh[order2]
-        pos_sorted = np.arange(n, dtype=np.int64) - np.searchsorted(
-            sh2, np.arange(self.n_shards + 1))[sh2]
-        ps = np.full(n, -1, np.int64)
-        ps[order2] = pos_sorted
-
-        w = self.max_batch
-        m = np.zeros((self.n_shards, REQ32_ROWS, w), np.int32)
-        m[:, REQ32_INDEX["slot"], :] = self.local_capacity
-        R = REQ32_INDEX
-        ix = np.flatnonzero(ok)
-        nodes, sel_ps = sh[ix], ps[ix]
-        m[nodes, R["slot"], sel_ps] = slots[ix]
-        m[nodes, R["known"], sel_ps] = known[ix]
-        m[nodes, R["algorithm"], sel_ps] = cols.algorithm[ix]
-        m[nodes, R["behavior"], sel_ps] = cols.behavior[ix]
-        m[nodes, R["valid"], sel_ps] = 1
-
-        def put_wide(name, vals):
-            lo32, hi32 = split_i64(np.asarray(vals, np.int64))
-            r = R[name]
-            m[nodes, r, sel_ps] = lo32
-            m[nodes, r + 1, sel_ps] = hi32
-
-        put_wide("hits", cols.hits[ix])
-        put_wide("limit", cols.limit[ix])
-        put_wide("duration", cols.duration[ix])
-        ca = cols.created_at[ix]
-        put_wide("created_at", np.where(ca != CREATED_UNSET, ca, now))
-        put_wide("burst", cols.burst[ix])
-        put_wide("greg_exp", greg_e[ix])
-        put_wide("greg_dur", greg_d[ix])
-
-        # Duplicate-free windows (adjacent-equal check on the sort
-        # key already built for order2) dispatch the parts-native
-        # program — the fused Mosaic kernel per shard on the row
-        # layout; duplicate-bearing windows keep the merge-capable
-        # x64 program wholesale (cross-member sequencing).
-        key_sorted = key[order2]
-        slots_sorted = safe_slots[order2]
-        # guber: allow-G001(sort keys are host numpy, never device)
-        has_dups = bool(np.any(
-            (key_sorted[1:] == key_sorted[:-1])
-            & (slots_sorted[1:] < self.local_capacity)
-        ))
-        if has_dups:
-            self.state, resp = self.ops.tick(
-                self.state, self.ops.put3(m), jnp.int64(now)
-            )
-        else:
-            self.state, resp = self.ops.run_tick_unique(
-                self.state, self.ops.put3(m), jnp.int64(now)
-            )
-        self._pending.clear()
-        wt_args = None
-        if self.store is not None:
-            wt_args = (cols.refs, list(range(n)), ix, sh, slots, now)
-        handle = MeshTickHandle(
-            self, resp, n, sh, np.where(ok, ps, -1), errors,
-            limit_req=cols.limit, wt_args=wt_args,
-        )
         if self.store is not None:
             handle.result()
         return handle
@@ -1415,20 +1260,21 @@ class MeshTickEngine:
             self._table_layout_conf, tr.cap_to, mesh.devices.flat[0],
             self.max_batch,
         )
-        lw = self._local_width_conf
-        if not lw:
-            lw = max(64, -(-5 * self.max_batch // (4 * tr.n_to)))
-            lw = -(-lw // 64) * 64
-        lw = min(int(lw), self.max_batch)
-        ops = ShardedOps(mesh, tr.cap_to, layout, local_width=lw)
+        ops = ShardedOps(mesh, tr.cap_to, layout)
+        # The ragged extent spec IS the new layout's dispatch geometry:
+        # post-cutover windows derive their offsets against cap_to's
+        # ownership from this object — nothing width-shaped survives to
+        # re-derive (the old routed path's local_width knob is dead).
         return SimpleNamespace(
             mesh=mesh, n_shards=tr.n_to, local_capacity=tr.cap_to,
-            capacity=tr.capacity_to, local_width=lw, layout=layout,
+            capacity=tr.capacity_to, layout=layout,
+            ragged=RaggedExtents(tr.n_to, tr.cap_to),
             ops=ops, state=ops.init_state(),
             slots=[make_slot_map(tr.cap_to) for _ in range(tr.n_to)],
             last_access=np.zeros(tr.capacity_to, np.int64),
             staging=StagingRing(
-                REQ32_ROWS, tr.capacity_to, self._staging_slabs),
+                REQ32_ROWS, tr.capacity_to, self._staging_slabs,
+                width=self.max_batch),
         )
 
     @hot_path
@@ -1442,14 +1288,14 @@ class MeshTickEngine:
         tuple assignment — zero loss either way."""
         saved = (
             self.mesh, self.n_shards, self.local_capacity, self.capacity,
-            self.local_width, self.layout, self.ops, self.state,
+            self.ragged, self.layout, self.ops, self.state,
             self.slots, self._last_access, self._staging, self._pending,
         )
         self.mesh = new.mesh
         self.n_shards = new.n_shards
         self.local_capacity = new.local_capacity
         self.capacity = new.capacity
-        self.local_width = new.local_width
+        self.ragged = new.ragged
         self.layout = new.layout
         self.ops = new.ops
         self.state = new.state
@@ -1465,7 +1311,7 @@ class MeshTickEngine:
         except Exception:
             (
                 self.mesh, self.n_shards, self.local_capacity,
-                self.capacity, self.local_width, self.layout, self.ops,
+                self.capacity, self.ragged, self.layout, self.ops,
                 self.state, self.slots, self._last_access, self._staging,
                 self._pending,
             ) = saved

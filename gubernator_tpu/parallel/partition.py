@@ -13,21 +13,23 @@ read the whole placement story in one file:
 * :class:`ShardLayout` — the partitioned serving table.  The SoA bucket
   state is split over the 1-D ``('shard',)`` mesh by contiguous slot
   range (device *d* owns global slots ``[d*local_cap, (d+1)*local_cap)``);
-  request/response blocks are either *blocked* (leading shard axis, the
-  host-routed legacy format) or *flat replicated* (the device-routed
-  format — one (19, B) matrix broadcast to every shard, each shard
-  compacting its own rows on device).
+  tick request/response traffic is *flat replicated* — one slot-sorted
+  (19, B) matrix plus a ragged ``offsets`` vector broadcast to every
+  shard, each shard walking only its own extent on device
+  (ops.raggedtick) — while maintenance blocks (evict/install/restore/
+  readback) keep the leading shard axis.
 * :class:`NodeLayout` — the replicated GLOBAL table.  One replica row
   per node (``P('node', None)``), accumulator/aux matrices alongside,
   scalars replicated.
 
-The device-side routing kernels live here too (:func:`route_block`,
-:func:`scatter_flat`): they are pure functions of the replicated flat
-request matrix and the shard index, shared by every routed program the
-mesh engine builds, and their contract (global-slot ownership derived
-as ``slot // local_capacity`` — nothing else) IS the on-device routing
-design: the host never regroups requests per shard, and the response
-fan-in is one ``psum``.
+The ragged extent spec lives here too (:class:`RaggedExtents`): the
+flat batch is sorted by GLOBAL slot and ownership is ``slot //
+local_capacity`` — nothing else — so each shard's rows form one
+contiguous extent and the host-side per-shard counts compress to a
+cumulative offsets vector.  Every producer of that vector (the serving
+dispatch, reshard's post-cutover dispatches, the tests' extent audits)
+derives it from this ONE dataclass, so the host packer and the
+on-device extent walker can never drift on where a shard's rows live.
 """
 
 from __future__ import annotations
@@ -41,7 +43,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.buckets import BucketState
-from gubernator_tpu.ops.engine import REQ32_INDEX
 from gubernator_tpu.ops.rowtable import RowState
 
 
@@ -69,9 +70,14 @@ class ShardLayout:
         return P(self.shard_axis, None, None)
 
     def flat2(self) -> P:
-        """(ROWS, B) device-routed flat request matrix — replicated to
-        every shard; each device compacts its own rows on device."""
+        """(ROWS, B) flat slot-sorted request matrix — replicated to
+        every shard; each device walks only its own ragged extent."""
         return P(None, None)
+
+    def offsets1(self) -> P:
+        """(n_shards + 1,) ragged extent offsets (RaggedExtents.offsets)
+        — replicated; each shard reads its own ``[my, my + 1]`` pair."""
+        return P(None)
 
     def scalar(self) -> P:
         """Replicated scalar (``now`` stamps, flags)."""
@@ -119,60 +125,44 @@ class NodeLayout:
 
 
 # ----------------------------------------------------------------------
-# Device-side routing (traced; called inside the mesh engine's shard_map
-# programs).  The flat request matrix carries GLOBAL slots in its slot
-# row; ownership is derived from the slot value alone.
+# Ragged extents (the on-device tick's wire spec).  The flat request
+# matrix carries GLOBAL slots in its slot row and is sorted by them;
+# ownership is derived from the slot value alone, so shard s's rows are
+# the contiguous extent [offsets[s], offsets[s+1]).
 # ----------------------------------------------------------------------
-def route_block(m: jnp.ndarray, my: jnp.ndarray, local_capacity: int,
-                local_width: int):
-    """Compact this shard's rows out of the replicated flat batch.
+@dataclass(frozen=True)
+class RaggedExtents:
+    """Host-side ragged extent spec for one (n_shards, local_capacity)
+    layout: how a resolved batch's per-shard row counts become the
+    ``(n_shards + 1,)`` cumulative offsets vector the extent walker
+    (ops.raggedtick) consumes.
 
-    ``m`` is the (REQ32_ROWS, B) compact request matrix, slot row
-    carrying GLOBAL slots (padding/error lanes carry the global
-    capacity sentinel and belong to no shard).  Returns ``(blk, src)``:
+    The spec is layout-bearing state: ``MeshTickEngine`` swaps it
+    atomically in ``_cutover`` alongside the mesh/ops/slotmaps, so a
+    reshard recomputes every subsequent window's offsets against the
+    NEW ``cap_to``-derived ownership — there is no residual width knob
+    to re-derive (the old routed path's ``local_width``)."""
 
-    * ``blk`` — the shard's (REQ32_ROWS, local_width) LOCAL request
-      block: slot row rebased to ``[0, local_capacity)``, guard-padded
-      (slot = local_capacity, valid = 0) past this shard's row count.
-      Host-side slot-sorted order is preserved by the stable compaction,
-      so the per-shard sorted-input tick contract holds for free.
-    * ``src`` — the (local_width,) response scatter map: local lane p's
-      response belongs at flat lane ``src[p]``; unfilled lanes aim one
-      past the batch and drop.
+    n_shards: int
+    local_capacity: int
 
-    The host guarantees per-shard row counts fit ``local_width`` (it
-    knows the counts before dispatch and falls back to the blocked
-    format otherwise), so the compaction never truncates live rows.
-    """
-    R = REQ32_INDEX
-    slot_g = m[R["slot"]]
-    valid = m[R["valid"]] != 0
-    b = slot_g.shape[0]
-    lo = my.astype(slot_g.dtype) * local_capacity
-    mine = valid & (slot_g >= lo) & (slot_g < lo + local_capacity)
-    pos = jnp.cumsum(mine.astype(jnp.int32)) - 1
-    tgt = jnp.where(mine, pos, local_width)
-    local = m.at[R["slot"]].set(
-        jnp.where(mine, slot_g - lo, local_capacity).astype(m.dtype)
-    )
-    local = local.at[R["valid"]].set(mine.astype(m.dtype))
-    blk = jnp.zeros((m.shape[0], local_width), m.dtype)
-    blk = blk.at[R["slot"]].set(local_capacity)
-    blk = blk.at[:, tgt].set(local, mode="drop")
-    src = jnp.full(local_width, b, jnp.int32).at[tgt].set(
-        jnp.arange(b, dtype=jnp.int32), mode="drop"
-    )
-    return blk, src
+    def counts(self, sh: np.ndarray, ok: np.ndarray) -> np.ndarray:
+        """Per-shard live row counts of one resolved batch (``sh`` the
+        per-request shard route, ``ok`` the live mask)."""
+        if not ok.any():
+            return np.zeros(self.n_shards, np.int64)
+        return np.bincount(sh[ok], minlength=self.n_shards)
 
-
-def scatter_flat(resp: jnp.ndarray, src: jnp.ndarray, b: int) -> jnp.ndarray:
-    """Scatter a shard's (ROWS, local_width) response block to its flat
-    lanes: the per-shard half of the collective response gather (the
-    cross-shard half is one ``psum`` — rows no shard owns stay zero)."""
-    out = jnp.zeros(resp.shape[:-1] + (b,), resp.dtype)
-    if resp.ndim == 1:
-        return out.at[src].set(resp, mode="drop")
-    return out.at[:, src].set(resp, mode="drop")
+    def offsets(self, counts: np.ndarray) -> np.ndarray:
+        """Cumulative extent offsets: shard s owns sorted lanes
+        ``[offsets[s], offsets[s+1])``.  Valid because the packed batch
+        sorts by GLOBAL slot (engine.sort_packed_by_slot) and global
+        slots of shard s are exactly ``[s*cap, (s+1)*cap)`` — shards
+        ascend with the sort, error/padding lanes (sentinel slot) sort
+        past every extent."""
+        off = np.zeros(self.n_shards + 1, np.int32)
+        off[1:] = np.cumsum(counts)
+        return off
 
 
 # ----------------------------------------------------------------------
@@ -191,8 +181,8 @@ class LayoutTransition:
     boundaries move.  Under the contiguous-range rule (``ShardLayout``:
     shard ``d`` owns ``[d*cap, (d+1)*cap)``) the new owner of ``g`` is
     ``g // cap_to`` and its new local offset ``g % cap_to`` — the same
-    single derivation :func:`route_block` applies to request slots, now
-    applied to the table itself.
+    single derivation :class:`RaggedExtents` applies to request slots,
+    now applied to the table itself.
 
     ``live_slots`` is the number of slots carrying state (the old
     layout's total capacity on a first transition); ``cap_to`` is sized
@@ -280,7 +270,7 @@ def relayout_block(x: jnp.ndarray, my: jnp.ndarray,
     (guard rows already stripped by the caller).  Each row's target
     placement in the NEW layout is derived from its global slot alone —
     ``slot // cap_to`` picks the new owner, ``slot % cap_to`` the new
-    local offset — mirroring :func:`route_block`'s ownership rule.  The
+    local offset — mirroring :class:`RaggedExtents`'s ownership rule.  The
     scatter lands rows in a zeroed ``(n_to * cap_to, ...)`` buffer;
     summing the per-shard buffers over the shard axis (one ``psum``,
     the caller's half) completes the exchange, because live slot ranges

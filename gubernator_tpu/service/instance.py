@@ -78,8 +78,8 @@ class InstanceConfig:
     replicas: int = 512
     tpu_max_batch: int = 4096
     tpu_mesh_shards: int = 0             # 0 = single-chip engine
-    mesh_routing: str = "auto"           # sharded key routing: device/host
-    mesh_local_width: int = 0            # routed per-shard lanes (0 = auto)
+    mesh_routing: str = "auto"           # sharded key routing: auto/device
+    mesh_local_width: int = 0            # DEPRECATED (ragged path; warns)
     tpu_platform: str = ""               # force jax platform ("cpu" for tests)
     tpu_table_layout: str = "auto"       # bucket-table storage (engine.py)
     tpu_bg_reclaim: str = "auto"         # background reclamation (engine.py)

@@ -354,20 +354,23 @@ class Metrics:
             "fell back to the dense program.",
             registry=reg,
         )
-        # Sharded serving table (parallel/mesh_engine.py): the
-        # device-routed flat tick is the serving format; a sustained
-        # overflow rate means hash skew keeps exceeding the routed
-        # per-shard block (raise GUBER_MESH_LOCAL_WIDTH).
+        # Sharded serving table (parallel/mesh_engine.py): the ragged
+        # flat tick is the ONE serving format — each shard walks its
+        # own extent of the slot-sorted batch, so there is no per-shard
+        # width to overflow.  The overflow counter survives as a
+        # pinned-zero canary (check_bench_regression gates it at 0).
         self.mesh_routed_windows = Counter(
             "gubernator_tpu_mesh_routed_windows",
-            "Serving windows dispatched through the device-routed flat "
-            "tick (each shard compacts its own rows on device).",
+            "Serving windows dispatched through the ragged flat tick "
+            "(each shard walks its own extent of the slot-sorted "
+            "batch on device).",
             registry=reg,
         )
         self.mesh_routed_overflows = Counter(
             "gubernator_tpu_mesh_routed_overflows",
-            "Serving windows that exceeded the routed per-shard block "
-            "width and fell back to host-blocked packing for that tick.",
+            "Pinned-zero canary: the retired routed path's skew "
+            "fallback count. The ragged dispatch has no per-shard "
+            "width, so any increment is a bug.",
             registry=reg,
         )
 

@@ -59,6 +59,20 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   reshard_parity_errors          0   — routed-path ownership agrees
 #                                        with the host ring on the
 #                                        post-transition layout
+#   mesh_routed_overflows          0   — pinned-zero canary: the ragged
+#                                        dispatch has no per-shard width,
+#                                        so the retired routed path's
+#                                        skew fallback can never fire —
+#                                        even on the Zipf-1.2 rung
+#   mesh_ragged_parity_errors      0   — the mesh_zipf_8 rung's per-
+#                                        request decisions match a
+#                                        single-chip TickEngine replay
+#                                        of the same schedule exactly
+#   mesh_trace_retraces            0   — serving windows reuse the
+#                                        warmup-compiled ragged programs;
+#                                        trace_counts never grows after
+#                                        warmup (one program per batch
+#                                        capacity, not per width)
 #   expired_served                 0   — the overload rung's requests
 #                                        whose deadline passed before
 #                                        packing must be shed, never
@@ -97,6 +111,9 @@ COUNT_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "mesh_routed_overflows",
+    "mesh_ragged_parity_errors",
+    "mesh_trace_retraces",
     "reshard_state_loss",
     "reshard_double_served",
     "reshard_parity_errors",
@@ -239,6 +256,9 @@ ABSOLUTE_ZERO_KEYS = (
     "mesh_routing_parity_errors",
     "mesh_dropped_keys",
     "mesh_double_served",
+    "mesh_routed_overflows",
+    "mesh_ragged_parity_errors",
+    "mesh_trace_retraces",
     "reshard_state_loss",
     "reshard_double_served",
     "reshard_parity_errors",

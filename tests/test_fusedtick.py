@@ -162,6 +162,73 @@ def test_fused_single_chunk_width():
         np.asarray(s_f.table), np.asarray(s_p.table))
 
 
+@pytest.mark.parametrize("case", ["full", "odd", "tiny", "empty"])
+def test_ragged_fused_matches_plain_on_extent(case):
+    """The ragged Pallas kernel, walking only ``[start, start + count)``
+    of a flat global-slot batch, matches the plain program run on the
+    localized extent alone — and leaves every off-extent response lane
+    exactly zero (the cross-shard gather is a psum).
+
+    ``odd`` picks an unaligned start and an odd chunk count (the
+    phantom-chunk even-rounding path); ``tiny`` is a sub-chunk extent
+    (nc_live == 1 rounds to 2); ``empty`` skips the pipeline entirely.
+    """
+    from gubernator_tpu.ops.raggedtick import make_fused_ragged_tick_fn
+
+    b = 4 * SMALL_CHUNK
+    start, count = {
+        "full": (0, b),
+        "odd": (37, 3 * SMALL_CHUNK - 5),
+        "tiny": (5, 7),
+        "empty": (50, 0),
+    }[case]
+    lo = CAP  # this shard's slot base in a 3-shard global slot space
+
+    rng = np.random.default_rng(31)
+    ragged = jax.jit(make_fused_ragged_tick_fn(CAP, chunk=SMALL_CHUNK))
+    plain = make_plain(CAP)
+
+    state0 = jax.tree.map(jnp.asarray, RowState.zeros(CAP))
+    state0 = populate(rng, plain, state0, b)
+
+    # Local batch (live rows at columns [0, count)), rolled so the live
+    # block sits at [start, start + count): the oracle input.  The
+    # global matrix rebases the extent's slots by +lo and plants live
+    # FOREIGN rows on both sides — other shards' slots with nonzero
+    # hits — which the kernel must skip purely by lane index.
+    m_oracle = np.roll(build_batch(rng, b, count), start, axis=1)
+    m_glob = m_oracle.copy()
+    m_glob[REQ32_INDEX["slot"], start:start + count] += lo
+    if start:
+        m_glob[REQ32_INDEX["slot"], :start] = np.sort(
+            rng.choice(lo, start, replace=False))
+        m_glob[REQ32_INDEX["valid"], :start] = 1
+        m_glob[REQ32_INDEX["hits"], :start] = 999
+    tail = b - start - count
+    if tail:
+        m_glob[REQ32_INDEX["slot"], start + count:] = (
+            lo + CAP + np.arange(tail))
+        m_glob[REQ32_INDEX["valid"], start + count:] = 1
+        m_glob[REQ32_INDEX["hits"], start + count:] = 999
+
+    now = jnp.int64(NOW)
+    s_f, r_f = ragged(state0, jnp.asarray(m_glob),
+                      np.int32(start), np.int32(count), np.int32(lo), now)
+    s_p, r_p = plain(state0, jnp.asarray(m_oracle), now)
+
+    r_f = np.asarray(r_f)
+    np.testing.assert_array_equal(
+        r_f[:, start:start + count],
+        np.asarray(r_p)[:, start:start + count])
+    off = np.ones(b, bool)
+    off[start:start + count] = False
+    assert (r_f[:, off] == 0).all()
+    # the guard row collects masked-lane scatters on both paths; compare
+    # only real slots
+    np.testing.assert_array_equal(
+        np.asarray(s_f.table)[:CAP], np.asarray(s_p.table)[:CAP])
+
+
 def test_fused_merged_matches_xla_merged():
     """The fused merged kernel (count fold in-register, 15-row resp) and
     the XLA merged rows program agree on state and every output row."""

@@ -1,9 +1,14 @@
 """Reshard protocol tests: admission freeze, quiesce, the coordinator
 state machine (commit / abort / rollback), and the crash journal.
 
-Everything here runs against stub engines or the single-chip TickLoop —
-the mesh-engine relayout itself is covered by test_mesh_engine.py and
-the reshard_live bench rung; no mesh builds happen in this module.
+Nearly everything here runs against stub engines or the single-chip
+TickLoop — the mesh-engine relayout itself is covered by
+test_mesh_engine.py and the reshard_live bench rung.  The ONE mesh
+build in this module is the reshard × ragged composition case at the
+bottom (a deliberately tiny 8→3→8 engine), because what it pins is the
+coordinator-visible outcome: extent offsets recomputed against the new
+``cap_to`` keep ``state_loss`` / ``double_served`` at zero under
+Zipf-skewed ragged dispatch.
 """
 
 import threading
@@ -351,3 +356,61 @@ def test_interrupted_detection_counts_metric():
     coord.record_interrupted(TransitionRecord("begin", 8, 4, epoch=3))
     assert m.sample("gubernator_tpu_reshard_transitions_total",
                     {"result": "interrupted"}) == 1
+
+
+# ---------------------------------------------------------------------------
+# Reshard × ragged dispatch composition (the one mesh build here; see
+# the module docstring)
+# ---------------------------------------------------------------------------
+def test_reshard_ragged_zipf_round_trip_zero_loss():
+    """8→3→8 through the full coordinator protocol with Zipf-skewed
+    traffic served by the ragged dispatch on every layout: the extent
+    offsets are recomputed against each layout's ``cap_to``, so
+    ``state_loss`` / ``double_served`` / ``parity_errors`` stay 0 and
+    decisions keep matching a single-chip replay across both cutovers.
+    The overflow canary must never move — skew has no fallback."""
+    import jax
+    import numpy as np
+
+    from gubernator_tpu.ops.engine import TickEngine
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+    from gubernator_tpu.utils import timeutil
+
+    # Wall-clock base: the coordinator's cutover stamps load_items with
+    # the real clock, so synthetic epochs would expire every item at
+    # the relayout boundary.
+    NOW = timeutil.now_ms()
+    eng = MeshTickEngine(
+        mesh=make_mesh(jax.devices()), local_capacity=16, max_batch=32
+    )
+    ref = TickEngine(capacity=8 * 16, max_batch=32)
+    coord = ReshardCoordinator(eng, verify=True)
+    rng = np.random.default_rng(29)
+
+    def zipf_window(width):
+        return [
+            RateLimitRequest(
+                name="zr", unique_key=f"z{int(rng.zipf(1.2)) % 40}",
+                hits=1, limit=10_000, duration=3_600_000,
+            )
+            for _ in range(width)
+        ]
+
+    def serve_and_compare(t):
+        reqs = zipf_window(int(rng.integers(8, 33)))
+        a = eng.process(reqs, now=NOW + t)
+        b = ref.process(reqs, now=NOW + t)
+        assert [(r.status, r.remaining, r.error) for r in a] == \
+               [(r.status, r.remaining, r.error) for r in b]
+
+    for t in range(2):
+        serve_and_compare(t)
+    for leg, (target, t0) in enumerate([(3, 100), (8, 200)]):
+        res = coord.reshard(target)
+        assert res["outcome"] == "committed", res
+        assert res["to_shards"] == target == eng.n_shards
+        assert res["state_loss"] == 0 and res["double_served"] == 0
+        assert res["parity_errors"] == 0
+        for t in range(2):
+            serve_and_compare(t0 + t)
+    assert eng.metric_routed_overflows == 0

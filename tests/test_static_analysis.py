@@ -423,6 +423,26 @@ def test_g006_shard_map_and_partial(tmp_path):
     assert len(out) == 2
 
 
+def test_g006_sees_ragged_tick_wrappers():
+    """The sharded engine's ragged tick wrappers are traced through
+    shard_map by NAME — pin that G006's traced-function discovery still
+    sees them (renaming or inlining them would silently drop the
+    trace-purity guard from the serving path's hottest programs)."""
+    import ast
+
+    from gubernator_tpu.analysis.rules import _traced_functions
+
+    path = os.path.join(
+        REPO_ROOT, "gubernator_tpu", "parallel", "mesh_engine.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    traced = {
+        fn.name for fn, _ in _traced_functions(tree)
+        if hasattr(fn, "name")
+    }
+    assert {"_tick_ragged", "_tick32_ragged"} <= traced
+
+
 # ----------------------------------------------------------------------
 # Suppression + baseline mechanics
 # ----------------------------------------------------------------------
@@ -523,9 +543,8 @@ def test_repo_hot_path_markers_present():
         "gubernator_tpu/ops/engine.py": [
             "_build_cols", "_lease_matrix", "_promote_misses",
             "submit_columns", "submit_cols", "submit", "lease_window"],
-        # The sharded serving path: resolve + both dispatch formats
-        # (device-routed flat and host-blocked fallback) all run per
-        # serving window.
+        # The sharded serving path: resolve + the ragged flat dispatch
+        # (the ONE serving format) run per serving window.
         # _dispatch_relayout/_cutover are the reshard transition's
         # bounded window (docs/resharding.md): every serving window is
         # frozen behind them, so G001 keeps them sync- and I/O-free.
@@ -533,7 +552,7 @@ def test_repo_hot_path_markers_present():
             "submit_columns", "submit_cols", "submit",
             "_gregorian_cols", "_resolve_columns",
             "_resolve_columns_locked", "_account_misses",
-            "_dispatch_routed", "_dispatch_blocked",
+            "_dispatch_ragged",
             "_dispatch_relayout", "_cutover"],
         "gubernator_tpu/service/tickloop.py": ["_run", "_flush"],
         # Overload control plane (docs/overload.md): queue admission,
