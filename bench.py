@@ -12,6 +12,10 @@ Rungs (BASELINE.json "configs", benchmark_test.go:30-148):
   engine_mixed_10m_zipf  same, mixed token+leaky, 10M keys, Zipf-skewed
                        hits, table at capacity with reclaim live
                        (p99 target: < 2ms per decision batch)
+  engine_mixed_algos   all five algorithms (token, leaky, sliding-
+                       window, GCRA, concurrency) in one Zipf stream —
+                       zoo parity vs the scalar references and the
+                       one-dispatch-per-window pin (docs/algorithms.md)
   herd_token_4096 /    thundering herd: 4096 hits of ONE key per tick vs
   herd_leaky_4096      the unique-key tick (benchmark_test.go:122-147)
   snapshot_10m         export_items/load_items round-trip on the big
@@ -556,6 +560,146 @@ def rung_herd(unique_dps, algo, label):
         "decisions_per_sec": round(dps, 1),
         "spread": round((seg[-1] - seg[0]) / max(seg[-1], 1e-9), 3),
         "vs_unique_key_engine": round(dps / unique_dps, 4) if unique_dps else None,
+    }
+
+
+def rung_engine_mixed_algos(label="engine_mixed_algos"):
+    """All five algorithms in one Zipf-skewed stream through a single
+    TickEngine — the algorithm zoo's acceptance rung
+    (docs/algorithms.md).  A key's algorithm is a function of the key
+    (``id % 5``), so every window mixes token, leaky, sliding-window,
+    GCRA, and concurrency lanes, with Zipf duplicates of all five.
+
+    Exports the zoo gates (scripts/check_bench_regression.py):
+
+      mixed_algo_parity_errors        zoo-lane decisions vs the scalar
+                                      Python references replaying the
+                                      identical stream, compared with
+                                      ``==`` — all-integer math, no
+                                      tolerance (ABSOLUTE_ZERO)
+      mixed_algo_dispatches_per_step  device tick programs per window —
+                                      a mixed-policy batch, duplicates
+                                      and all, stays ONE dispatch
+                                      (absolute ceiling 1.0)
+    """
+    from gubernator_tpu.algos import reference
+    from gubernator_tpu.ops import tick32
+    from gubernator_tpu.ops.engine import TickEngine
+
+    now = 1_700_000_000_000
+    batch = 1024
+    n_keys = 4096
+    iters = 10 if FAST else 40
+    rng = np.random.default_rng(11)
+    # capacity >= 2^14 keeps the layered mixed-duplicate path live (the
+    # production dispatch for Zipf zoo duplicates, which are fold-exempt
+    # and ride size-1 units — docs/algorithms.md).
+    engine = TickEngine(capacity=1 << 15, max_batch=batch)
+
+    def window():
+        ids = np.minimum(rng.zipf(1.2, batch) - 1, n_keys - 1)
+        blob, offsets = _key_pack(ids)
+        n = len(ids)
+        hits = rng.choice([1, 1, 1, 2, 0, -1], n).astype(np.int64)
+        from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns
+
+        def full(v):
+            return np.full(n, v, np.int64)
+
+        return ReqColumns(
+            blob, offsets, hits, full(100), full(60_000),
+            (ids % 5).astype(np.int64), full(0), full(CREATED_UNSET),
+            full(0), name_len=full(len("bench")),
+        )
+
+    windows = [window() for _ in range(8)]
+
+    # Count device tick programs per window: wrap the three engine-held
+    # programs and the layered-pipeline factory (the four tick paths a
+    # submit can take) — any mixed-policy fallback to per-algorithm
+    # sub-batches would show up as a second dispatch.
+    dispatches = [0]
+
+    def counted(fn):
+        def run(*a, **kw):
+            dispatches[0] += 1
+            return fn(*a, **kw)
+        return run
+
+    for name in ("_tick32", "_tick32m", "_tick"):
+        setattr(engine, name, counted(getattr(engine, name)))
+    orig_layered = tick32.jitted_layered_pipeline
+
+    def layered(*a, **kw):
+        return counted(orig_layered(*a, **kw))
+
+    tick32.jitted_layered_pipeline = layered
+    try:
+        for c in windows:  # warm/compile every shape the loop replays
+            engine.process_columns(c, now=now)
+        d0, t0 = dispatches[0], time.perf_counter()
+        resps = []
+        for i in range(iters):
+            got, _ = engine.process_columns(
+                windows[i % len(windows)], now=now + 1 + i
+            )
+            resps.append(got)
+        dt = time.perf_counter() - t0
+        steps = iters
+        dps = dispatches[0] - d0
+    finally:
+        tick32.jitted_layered_pipeline = orig_layered
+
+    # Replay the identical schedule (warmup included — the engine table
+    # carries its state) through the scalar references, zoo lanes only;
+    # token/leaky parity is the layout-fuzz suite's job.
+    model = {}
+
+    def replay(c, t):
+        want = []
+        n = len(c.hits)
+        for j in range(n):
+            alg = int(c.algorithm[j])
+            if alg < 2:
+                want.append(None)
+                continue
+            key = bytes(
+                c.key_blob[c.key_offsets[j]:c.key_offsets[j + 1]]
+            )
+            ns, resp = reference.transition(
+                model.get(key),
+                dict(hits=int(c.hits[j]), limit=int(c.limit[j]),
+                     duration=int(c.duration[j]), algorithm=alg,
+                     behavior=int(c.behavior[j]), burst=int(c.burst[j]),
+                     created_at=t),
+                t,
+            )
+            model[key] = ns
+            want.append(
+                (resp["status"], resp["remaining"], resp["reset_time"])
+            )
+        return want
+
+    for c in windows:
+        replay(c, now)
+    parity_errors = 0
+    for i in range(iters):
+        c = windows[i % len(windows)]
+        want = replay(c, now + 1 + i)
+        got = resps[i]
+        for j, w in enumerate(want):
+            if w is None:
+                continue
+            g = (int(got[0, j]), int(got[2, j]), int(got[3, j]))
+            if g != w:
+                parity_errors += 1
+    return {
+        "rung": label,
+        "keys": n_keys,
+        "batch": batch,
+        "decisions_per_sec": round(iters * batch / dt, 1),
+        "mixed_algo_parity_errors": int(parity_errors),
+        "mixed_algo_dispatches_per_step": round(dps / max(steps, 1), 3),
     }
 
 
@@ -3107,6 +3251,8 @@ def main():
     ))
     unique_leaky_dps = ladder[-1].get("decisions_per_sec", 0)
 
+    ladder.append(_safe("engine_mixed_algos", rung_engine_mixed_algos))
+
     n_big = 1 << 20 if FAST else 10_000_000
     ladder.append(_safe(
         "engine_mixed_10m_zipf",
@@ -3364,6 +3510,10 @@ def compact_headline(record, ladder_file):
         # across the 8x working set is absolutely bounded.
         "ssd_continuity_errors", "ssd_tick_path_reads",
         "ssd_promote_batches_per_miss_tick", "churn_ssd_rss_mb",
+        # Algorithm-zoo gates (docs/algorithms.md): zoo-lane parity vs
+        # the scalar references is ABSOLUTE_ZERO, and a mixed-policy
+        # window must stay ONE device dispatch (ceiling 1.0).
+        "mixed_algo_parity_errors", "mixed_algo_dispatches_per_step",
     )
     count_map = {}
     for r in record["ladder"]:

@@ -1,0 +1,66 @@
+"""On-device algorithm zoo: sliding-window, GCRA, concurrency limits.
+
+This package generalizes :mod:`gubernator_tpu.ops.buckets`'s two-way
+``is_token`` select into an N-way branchless policy table over the SAME
+SoA state columns.  Each algorithm is a pure elementwise state
+transition ``(state_cols, req_cols) -> (state_cols', resp_cols)``
+written ONCE against an ops adapter (:mod:`gubernator_tpu.algos.table`)
+and instantiated twice:
+
+- **x64**: logical int64 jnp arrays — the oracle path used by
+  :func:`gubernator_tpu.ops.buckets.bucket_transition`.
+- **parts**: (lo, hi) int32 pairs (:mod:`gubernator_tpu.ops.i64pair`) —
+  Mosaic-compilable, used by
+  :func:`gubernator_tpu.ops.transition32.transition32` and therefore by
+  the fused/ragged Pallas ticks.
+
+Because both paths run the *same* formula through different arithmetic
+backends, the oracle/kernel parity that the fuzz suite enforces for
+token/leaky extends to the zoo for free.  Selection happens per lane on
+the existing ``algorithm`` column, so a mixed-policy window (all five
+algorithms in one batch) still ticks in ONE device dispatch with no new
+programs per algorithm.
+
+Scalar Python references (the test ground truth) live in
+:mod:`gubernator_tpu.algos.reference`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gubernator_tpu.types import ALGORITHM_MAX, Algorithm
+
+# Zoo members (selected when ``algorithm >= ZOO_MIN``); token/leaky stay
+# on the legacy two-way select inside the bucket transitions.
+ZOO_MIN = int(Algorithm.SLIDING_WINDOW)
+ZOO_ALGORITHMS = (
+    Algorithm.SLIDING_WINDOW,
+    Algorithm.GCRA,
+    Algorithm.CONCURRENCY,
+)
+
+# New SoA columns the zoo threads through the whole state plane
+# (snapshots, cold tier, mesh relayout).  Pre-zoo snapshots/slabs load
+# these as zeros — the PR 10 lease-column compatibility pattern.
+ZOO_STATE_FIELDS = ("tat", "prev_count")
+
+
+def invalid_algorithm_mask(algorithm: np.ndarray) -> np.ndarray:
+    """Boolean mask of wire ``algorithm`` values outside the enum range.
+
+    Used by the edges (fastwire / protobuf conversion / instance
+    validation) to reject unknown algorithms with INVALID_ARGUMENT
+    instead of letting them fall through the select tree as
+    token-bucket.
+    """
+    a = np.asarray(algorithm)
+    return (a < 0) | (a > int(ALGORITHM_MAX))
+
+
+def algorithm_error(value: int) -> str:
+    """The per-item error string for an out-of-range algorithm value."""
+    return (
+        f"invalid algorithm '{int(value)}': must be in "
+        f"[0, {int(ALGORITHM_MAX)}]"
+    )

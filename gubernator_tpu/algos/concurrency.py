@@ -1,0 +1,92 @@
+"""Concurrency / in-flight limit transition (Algorithm.CONCURRENCY).
+
+``remaining`` holds the free slot count.  Positive hits *acquire*
+slots (all-or-nothing, like a semaphore try-acquire), negative hits
+*release* them (clamped into ``[0, limit]``), and a bucket whose TTL
+lapses is simply re-created full — which IS the leaked-slot
+reclamation: a client that acquired and never released stops pinning
+its slots once the item's ``duration`` passes without a refresh, since
+every acquire/release bumps ``expire_at = t + duration`` and the shared
+cache-existence predicate treats ``now > expire_at`` as a miss.
+
+Semantics:
+
+- ``hits > 0``  acquire iff ``hits <= remaining``; rejected acquires
+  take nothing (DRAIN_OVER_LIMIT has no meaning for slots and is
+  ignored).
+- ``hits < 0``  release: ``remaining = clamp(remaining - hits, 0,
+  limit)``; always UNDER_LIMIT.
+- ``hits == 0`` status query (OVER_LIMIT iff no slot is free); does not
+  refresh the TTL.
+- A limit change re-bases the free count by the delta, token-bucket
+  style: ``remaining += new_limit - old_limit`` clamped at 0.
+- ``reset_time`` is ``expire_at`` — the moment leaked slots would be
+  reclaimed if every holder vanished.
+"""
+
+from __future__ import annotations
+
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
+import jax.numpy as jnp
+
+from gubernator_tpu.algos.table import ZooResp, ZooState
+from gubernator_tpu.types import Algorithm, Status
+from gubernator_tpu.utils.hotpath import hot_path
+
+I32 = jnp.int32
+
+
+@hot_path
+def transition(o, s, r, exists, reset_b, drain_b
+               ) -> tuple[ZooState, ZooResp]:
+    """Elementwise concurrency-limit step over backend ``o`` (table.py)."""
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+    zero = o.const(0, r.algorithm)
+
+    ex = exists & ~reset_b & (s.algorithm == jnp.int32(
+        Algorithm.CONCURRENCY))
+    t = r.created_at
+    # Existing bucket re-bases on a limit change; new/expired bucket
+    # starts full (leaked slots reclaimed).  Clamp keeps hostile stored
+    # values and limit <= 0 total: nothing is ever available below 0.
+    rebased = o.add(s.remaining, o.sub(r.limit, s.limit))
+    rem0 = o.max_(o.select(ex, rebased, r.limit), zero)
+
+    h = r.hits
+    h_pos = o.gt(h, zero)
+    h_neg = o.lt(h, zero)
+    h_query = o.is_zero(h)
+    fits = o.le(h, rem0)
+    admit = h_pos & fits
+    over = h_pos & ~fits
+
+    rem1 = o.select(
+        admit,
+        o.sub(rem0, h),
+        o.select(
+            h_neg,
+            o.max_(o.min_(o.sub(rem0, h), r.limit), zero),
+            rem0,
+        ),
+    )
+
+    touch = ~h_query | ~ex
+    expire = o.select(touch, o.add(t, r.duration), s.expire_at)
+    status = jnp.where(over | (h_query & o.is_zero(rem1)), OVER, UNDER)
+
+    st = ZooState(
+        remaining=rem1,
+        created_at=o.select(ex, s.created_at, t),
+        status=status,
+        expire_at=expire,
+        tat=zero,
+        prev_count=zero,
+    )
+    resp = ZooResp(
+        status=status,
+        remaining=rem1,
+        reset_time=expire,
+        over_limit=over.astype(I32),
+    )
+    return st, resp

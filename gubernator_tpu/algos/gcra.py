@@ -1,0 +1,115 @@
+"""GCRA (generic cell rate algorithm) transition (Algorithm.GCRA).
+
+Virtual-scheduling leaky bucket on a single ``tat`` (theoretical
+arrival time) column: with emission interval ``T = duration // limit``
+and tolerance ``tau = (burst_eff - 1) * T`` (``burst_eff = burst`` when
+positive, else ``limit``), a batch of ``hits`` conforms iff its *last*
+cell's theoretical arrival ``tat + (hits - 1) * T`` is within ``tau``
+of now.  Conforming hits advance ``tat`` by ``hits * T``; a stale
+``tat`` first catches up to now (``max(tat, t)``), which is what makes
+GCRA window-edge free — admission smooths at the single-cell scale
+instead of resetting at window boundaries (the perceived-fairness
+argument in docs/algorithms.md).
+
+Everything is exact integer math via the same non-negative floor-
+division machinery the group fold uses (``i64pair.div_floor_pos``'s
+triple-f32 quotient + exact correction), so oracle, parts kernel and
+scalar reference agree bit-exactly.
+
+Semantics:
+
+- ``hits > 0``  admit iff the whole batch conforms (all-or-nothing);
+  DRAIN_OVER_LIMIT is a no-op for GCRA (there is no stored count to
+  drain — over-limit leaves ``tat`` untouched).
+- ``hits < 0``  returns credit: ``tat' = max(tat + hits * T, t)``.
+- ``hits == 0`` status query (reports OVER_LIMIT iff no cell would
+  conform right now); does not bump cache expiry.
+- ``remaining`` reports the number of cells that would still conform:
+  ``min(slack // T + 1, burst_eff)`` for ``slack = t + tau - tat >= 0``,
+  else 0; ``T == 0`` (limit exceeds duration in ms) admits everything
+  and reports ``burst_eff``.
+- ``reset_time = max(tat - tau, t)``: the instant the next cell
+  conforms.  Expiry is ``max(t + duration, tat)`` so a bucket with
+  booked-ahead ``tat`` cannot expire before its debt drains.
+"""
+
+from __future__ import annotations
+
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
+import jax.numpy as jnp
+
+from gubernator_tpu.algos.table import ZooResp, ZooState
+from gubernator_tpu.types import Algorithm, Status
+from gubernator_tpu.utils.hotpath import hot_path
+
+I32 = jnp.int32
+
+
+@hot_path
+def transition(o, s, r, exists, reset_b, drain_b
+               ) -> tuple[ZooState, ZooResp]:
+    """Elementwise GCRA step over backend ``o`` (see table.py)."""
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+    zero = o.const(0, r.algorithm)
+    one = o.const(1, r.algorithm)
+
+    ex = exists & ~reset_b & (s.algorithm == jnp.int32(Algorithm.GCRA))
+    t = r.created_at
+    # Emission interval; floor_div domain needs duration >= 0, limit > 0
+    # (service validation rejects limit <= 0, the kernel stays total).
+    safe_limit = o.select(o.le(r.limit, zero), one, r.limit)
+    T = o.floor_div(o.max_(r.duration, zero), safe_limit)
+    burst_eff = o.select(o.gt(r.burst, zero), r.burst, r.limit)
+    tau = o.mul(o.sub(burst_eff, one), T)
+
+    tat0 = o.select(ex, s.tat, t)
+    tat1 = o.max_(tat0, t)  # stale tat catches up to now
+
+    h = r.hits
+    h_pos = o.gt(h, zero)
+    h_neg = o.lt(h, zero)
+    h_query = o.is_zero(h)
+    # Last cell of the batch: tat1 + (h - 1) * T must be <= t + tau.
+    need = o.add(tat1, o.mul(o.sub(h, one), T))
+    horizon = o.add(t, tau)
+    conform = o.le(need, horizon)
+    admit = h_pos & conform
+    over = h_pos & ~conform
+
+    stepped = o.add(tat1, o.mul(h, T))
+    tat2 = o.select(
+        admit, stepped,
+        o.select(h_neg, o.max_(stepped, t), tat1),
+    )
+
+    slack = o.sub(horizon, tat2)
+    t_zero = o.is_zero(T)
+    rem_div = o.add(o.floor_div(o.max_(slack, zero), o.max_(T, one)), one)
+    rem = o.select(
+        o.lt(slack, zero), zero,
+        o.select(t_zero, burst_eff, o.min_(rem_div, burst_eff)),
+    )
+    rem = o.max_(rem, zero)  # burst_eff <= 0 (limit <= 0) floors at 0
+
+    status = jnp.where(over | (h_query & o.is_zero(rem)), OVER, UNDER)
+    reset = o.max_(o.sub(tat2, tau), t)
+    touch = ~h_query | ~ex
+    expire = o.select(
+        touch, o.max_(o.add(t, r.duration), tat2), s.expire_at)
+
+    st = ZooState(
+        remaining=rem,
+        created_at=o.select(ex, s.created_at, t),
+        status=status,
+        expire_at=expire,
+        tat=tat2,
+        prev_count=zero,
+    )
+    resp = ZooResp(
+        status=status,
+        remaining=rem,
+        reset_time=reset,
+        over_limit=over.astype(I32),
+    )
+    return st, resp

@@ -1,0 +1,122 @@
+"""Sliding-window counter transition (Algorithm.SLIDING_WINDOW).
+
+Epoch-aligned windows of ``duration`` ms: the current window's count
+lives in ``remaining`` (reused as the *consumed* counter — unlike
+token bucket it counts up), the previous window's final count in the
+``prev_count`` column, and the current window start in ``created_at``
+(aligned to ``t - t % duration``).  The effective usage at time ``t``
+weights the previous window by its remaining overlap with the sliding
+window ending at ``t``::
+
+    used = prev * (duration - (t - window_start)) // duration + cur
+
+which is the standard Cloudflare-style approximation that kills the
+2x-burst artifact at fixed-window edges (see docs/algorithms.md for the
+window-edge analysis).  All math is integer, so the x64 oracle, the
+parts kernel and the scalar reference agree bit-exactly.
+
+Semantics:
+
+- ``hits > 0``  admit iff ``hits <= limit - used``; admitted hits add to
+  the current window.  Rejected hits consume nothing unless
+  DRAIN_OVER_LIMIT, which consumes exactly the available budget.
+- ``hits < 0``  un-counts from the current window (clamped at 0).
+- ``hits == 0`` status query; reports OVER_LIMIT iff nothing is
+  available.  Window rotation still persists, cache expiry is not
+  bumped.
+- RESET_REMAINING discards the stored window (fresh bucket).
+- ``reset_time`` is the current window's end; expiry is ``t + 2 *
+  duration`` so the previous-window count survives one full extra
+  window.
+"""
+
+from __future__ import annotations
+
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
+import jax.numpy as jnp
+
+from gubernator_tpu.algos.table import ZooResp, ZooState
+from gubernator_tpu.types import Algorithm, Status
+from gubernator_tpu.utils.hotpath import hot_path
+
+I32 = jnp.int32
+
+
+@hot_path
+def transition(o, s, r, exists, reset_b, drain_b
+               ) -> tuple[ZooState, ZooResp]:
+    """Elementwise sliding-window step over backend ``o`` (see table.py)."""
+    UNDER = jnp.int32(Status.UNDER_LIMIT)
+    OVER = jnp.int32(Status.OVER_LIMIT)
+    zero = o.const(0, r.algorithm)
+    one = o.const(1, r.algorithm)
+
+    ex = exists & ~reset_b & (s.algorithm == jnp.int32(
+        Algorithm.SLIDING_WINDOW))
+    # Window math needs t >= 0 and duration >= 1 (floor_div domain);
+    # epoch-ms inputs already satisfy both, the clamps keep the kernel
+    # total for hostile values.
+    t = o.max_(r.created_at, zero)
+    dur = o.max_(r.duration, one)
+    aligned = o.sub(t, o.mod(t, dur))
+
+    ws0 = o.select(ex, s.created_at, aligned)
+    cur0 = o.select(ex, o.max_(s.remaining, zero), zero)
+    prev0 = o.select(ex, o.max_(s.prev_count, zero), zero)
+
+    # Rotation: k full windows elapsed since the stored window start.
+    # k == 1 promotes current -> previous; k >= 2 clears both.  A
+    # duration change re-aligns the grid organically (k computed with
+    # the new duration).
+    delta = o.max_(o.sub(t, ws0), zero)  # clock-regress clamp
+    k = o.floor_div(delta, dur)
+    k0 = o.is_zero(k)
+    k1 = o.eq(k, one)
+    prev1 = o.select(k0, prev0, o.select(k1, cur0, zero))
+    cur1 = o.select(k0, cur0, zero)
+    ws1 = o.select(k0, ws0, aligned)
+
+    # Weighted previous-window overlap: frac in (0, dur].
+    frac = o.min_(o.max_(o.sub(dur, o.sub(t, ws1)), zero), dur)
+    wprev = o.floor_div(o.mul(prev1, frac), dur)
+    used = o.add(wprev, cur1)
+    avail = o.max_(o.sub(r.limit, used), zero)
+
+    h = r.hits
+    h_pos = o.gt(h, zero)
+    h_neg = o.lt(h, zero)
+    h_query = o.is_zero(h)
+    fits = o.le(h, avail)
+    admit = h_pos & fits
+    over = h_pos & ~fits
+
+    cur2 = o.select(
+        admit,
+        o.add(cur1, h),
+        o.select(
+            over & drain_b,
+            o.add(cur1, avail),
+            o.select(h_neg, o.max_(o.add(cur1, h), zero), cur1),
+        ),
+    )
+    resp_rem = o.max_(o.sub(r.limit, o.add(wprev, cur2)), zero)
+    status = jnp.where(over | (h_query & o.is_zero(avail)), OVER, UNDER)
+    reset = o.add(ws1, dur)
+    touch = ~h_query | ~ex
+    expire = o.select(touch, o.add(t, o.add(dur, dur)), s.expire_at)
+
+    st = ZooState(
+        remaining=cur2,
+        created_at=ws1,
+        status=status,
+        expire_at=expire,
+        tat=zero,
+        prev_count=prev1,
+    )
+    resp = ZooResp(
+        status=status,
+        remaining=resp_rem,
+        reset_time=reset,
+        over_limit=over.astype(I32),
+    )
+    return st, resp

@@ -1,0 +1,218 @@
+"""The N-way branchless policy table and its arithmetic backends.
+
+Every zoo algorithm (:mod:`sliding_window`, :mod:`gcra`,
+:mod:`concurrency`) is written once against the small ops protocol
+defined here and evaluated through two interchangeable backends:
+
+- :class:`X64Ops` — plain int64 jnp arrays (the logical/oracle path of
+  ``ops/buckets.py``).
+- :class:`PartsOps` — (lo, hi) int32 pairs via
+  :mod:`gubernator_tpu.ops.i64pair` (Mosaic-compilable; the
+  ``ops/transition32.py`` / fused-Pallas path).
+
+One formula, two instantiations: structural parity between the oracle
+and the kernel is by construction, not by testing alone.
+
+The adapters only cover what the zoo needs — elementwise 64-bit
+add/sub/mul/compare/select plus *non-negative* floor division (backed by
+``i64pair.div_floor_pos`` on parts; callers clamp operands into the
+``a >= 0, b > 0`` domain).  i32 lanes (status) and boolean masks use
+``jnp.where`` directly in both backends; boolean *values* are kept as
+0/1 int32 lanes through selects, the Mosaic-supported idiom (see
+transition32's ``sel32`` note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use)
+import jax.numpy as jnp
+
+from gubernator_tpu.ops import i64pair as p64
+from gubernator_tpu.types import Algorithm
+from gubernator_tpu.utils.hotpath import hot_path
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+class X64Ops:
+    """int64 jnp-array backend (logical/oracle path)."""
+
+    @staticmethod
+    def const(v, like):
+        return jnp.full(jnp.shape(like), v, I64)
+
+    @staticmethod
+    def add(a, b):
+        return a + b
+
+    @staticmethod
+    def sub(a, b):
+        return a - b
+
+    @staticmethod
+    def mul(a, b):
+        return a * b  # wrapping two's-complement, like i64pair.mul
+
+    @staticmethod
+    def eq(a, b):
+        return a == b
+
+    @staticmethod
+    def ne(a, b):
+        return a != b
+
+    @staticmethod
+    def lt(a, b):
+        return a < b
+
+    @staticmethod
+    def le(a, b):
+        return a <= b
+
+    @staticmethod
+    def gt(a, b):
+        return a > b
+
+    @staticmethod
+    def ge(a, b):
+        return a >= b
+
+    @staticmethod
+    def is_zero(a):
+        return a == 0
+
+    @staticmethod
+    def is_neg(a):
+        return a < 0
+
+    @staticmethod
+    def select(c, a, b):
+        return jnp.where(c, a, b)
+
+    @staticmethod
+    def max_(a, b):
+        return jnp.maximum(a, b)
+
+    @staticmethod
+    def min_(a, b):
+        return jnp.minimum(a, b)
+
+    @staticmethod
+    def floor_div(a, b):
+        # Domain a >= 0, b > 0 (callers clamp) — floor == trunc here.
+        return a // b
+
+    @staticmethod
+    def mod(a, b):
+        return a % b  # same a >= 0, b > 0 domain
+
+
+class PartsOps:
+    """(lo, hi) int32-pair backend (Mosaic-compilable parts path)."""
+
+    const = staticmethod(p64.const)
+    add = staticmethod(p64.add)
+    sub = staticmethod(p64.sub)
+    mul = staticmethod(p64.mul)
+    eq = staticmethod(p64.eq)
+    ne = staticmethod(p64.ne)
+    lt = staticmethod(p64.lt)
+    le = staticmethod(p64.le)
+    gt = staticmethod(p64.gt)
+    ge = staticmethod(p64.ge)
+    is_zero = staticmethod(p64.is_zero)
+    is_neg = staticmethod(p64.is_neg)
+    select = staticmethod(p64.select)
+    max_ = staticmethod(p64.max_)
+    min_ = staticmethod(p64.min_)
+    floor_div = staticmethod(p64.div_floor_pos)
+
+    @staticmethod
+    def mod(a, b):
+        # a - (a // b) * b on the same a >= 0, b > 0 domain.
+        return p64.sub(a, p64.mul(p64.div_floor_pos(a, b), b))
+
+
+class ZooState(NamedTuple):
+    """The state fields a zoo transition decides per lane.  The rest of
+    the row is uniform across zoo algorithms (algorithm/limit/duration/
+    burst echo the request; remaining_f is 0; updated_at = created_at;
+    in_use = 1) and is filled by the caller."""
+
+    remaining: Any    # i64 / I64 pair: window count, GCRA slack, free slots
+    created_at: Any   # i64: window start (sliding) / first-seen (others)
+    status: Any       # i32 lanes
+    expire_at: Any    # i64
+    tat: Any          # i64: GCRA theoretical arrival time; 0 elsewhere
+    prev_count: Any   # i64: sliding-window previous count; 0 elsewhere
+
+
+class ZooResp(NamedTuple):
+    """Response fields (cf. PResp); ``over_limit`` is 0/1 i32 lanes."""
+
+    status: Any       # i32 lanes
+    remaining: Any    # i64
+    reset_time: Any   # i64
+    over_limit: Any   # i32 0/1 lanes
+
+
+def _pick(o, alg, sw, gc, cc):
+    """3-way zoo select on 64-bit values (alg >= ZOO_MIN lanes only;
+    unknown values resolve to sliding-window — the edges reject them
+    before they ever reach the device)."""
+    is_gc = alg == jnp.int32(Algorithm.GCRA)
+    is_cc = alg == jnp.int32(Algorithm.CONCURRENCY)
+    return o.select(is_gc, gc, o.select(is_cc, cc, sw))
+
+
+def _pick32(alg, sw, gc, cc):
+    """3-way zoo select on i32 lanes."""
+    is_gc = alg == jnp.int32(Algorithm.GCRA)
+    is_cc = alg == jnp.int32(Algorithm.CONCURRENCY)
+    return jnp.where(is_gc, gc, jnp.where(is_cc, cc, sw))
+
+
+@hot_path
+def zoo_transitions(o, s, r, exists, reset_b, drain_b
+                    ) -> tuple[ZooState, ZooResp]:
+    """Run all three zoo transitions branchlessly and fold them into one
+    per-lane result keyed on ``r.algorithm``.
+
+    ``s``/``r`` are duck-typed state/request batches in the backend's
+    representation (BucketState/ReqBatch for :class:`X64Ops`,
+    PState/PReq for :class:`PartsOps` — the field names coincide).
+    ``exists``/``reset_b``/``drain_b`` are the caller's shared masks, so
+    cache-expiry semantics stay identical across all five algorithms.
+    """
+    from gubernator_tpu.algos import concurrency, gcra, sliding_window
+
+    sw_s, sw_r = sliding_window.transition(o, s, r, exists, reset_b, drain_b)
+    gc_s, gc_r = gcra.transition(o, s, r, exists, reset_b, drain_b)
+    cc_s, cc_r = concurrency.transition(o, s, r, exists, reset_b, drain_b)
+
+    alg = r.algorithm
+    st = ZooState(
+        remaining=_pick(o, alg, sw_s.remaining, gc_s.remaining,
+                        cc_s.remaining),
+        created_at=_pick(o, alg, sw_s.created_at, gc_s.created_at,
+                         cc_s.created_at),
+        status=_pick32(alg, sw_s.status, gc_s.status, cc_s.status),
+        expire_at=_pick(o, alg, sw_s.expire_at, gc_s.expire_at,
+                        cc_s.expire_at),
+        tat=_pick(o, alg, sw_s.tat, gc_s.tat, cc_s.tat),
+        prev_count=_pick(o, alg, sw_s.prev_count, gc_s.prev_count,
+                         cc_s.prev_count),
+    )
+    resp = ZooResp(
+        status=_pick32(alg, sw_r.status, gc_r.status, cc_r.status),
+        remaining=_pick(o, alg, sw_r.remaining, gc_r.remaining,
+                        cc_r.remaining),
+        reset_time=_pick(o, alg, sw_r.reset_time, gc_r.reset_time,
+                         cc_r.reset_time),
+        over_limit=_pick32(alg, sw_r.over_limit, gc_r.over_limit,
+                           cc_r.over_limit),
+    )
+    return st, resp

@@ -50,7 +50,12 @@ def configure_compile_cache(environ=None) -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except OSError:  # unwritable home: run uncached
-        pass
+        return
+    # jax's default floor (1s) only caches the big tick programs; the
+    # long tail of sub-second helper compiles (packers, scans, installs)
+    # recurs on every process start and dominates single-core cold
+    # starts — cache everything.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 configure_compile_cache()

@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from gubernator_tpu.algos import ZOO_MIN
+from gubernator_tpu.algos import table as zoo_table
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
 I64 = jnp.int64
@@ -81,6 +83,9 @@ STATE_DTYPES = {
     "status": I32,       # persisted Status (token bucket only)
     "expire_at": I64,    # epoch ms (CacheItem.ExpireAt)
     "in_use": jnp.bool_,  # slot holds a live item
+    # Algorithm-zoo columns (gubernator_tpu/algos/): zero for token/leaky.
+    "tat": I64,          # GCRA theoretical arrival time (epoch ms)
+    "prev_count": I64,   # sliding-window previous-window count
 }
 
 _WIDE = frozenset(k for k, dt in STATE_DTYPES.items() if dt == I64)
@@ -175,6 +180,8 @@ class BucketState(NamedTuple):
     status: jnp.ndarray
     expire_at: jnp.ndarray
     in_use: jnp.ndarray
+    tat: jnp.ndarray
+    prev_count: jnp.ndarray
 
     @classmethod
     def zeros(cls, n: int) -> "BucketState":
@@ -500,6 +507,18 @@ def bucket_transition(
     ln_expire = r.created_at + ln_duration
 
     # ------------------------------------------------------------------
+    # ALGORITHM ZOO (gubernator_tpu/algos): sliding-window / GCRA /
+    # concurrency lanes, computed branchlessly for every lane and folded
+    # by r.algorithm.  Legacy lanes keep the two-way select below.
+    # ------------------------------------------------------------------
+    is_zoo = r.algorithm >= jnp.int32(ZOO_MIN)
+    zs, zr = zoo_table.zoo_transitions(
+        zoo_table.X64Ops, s, r, exists, reset_b, drain_b)
+
+    def zsel(zoo_v, legacy_v):
+        return jnp.where(is_zoo, zoo_v, legacy_v)
+
+    # ------------------------------------------------------------------
     # Select per-request outcome
     # ------------------------------------------------------------------
     tok_new = is_token & ~tok_reset & ~tok_exist  # miss OR stored-algo mismatch
@@ -514,33 +533,66 @@ def bucket_transition(
 
     zero64 = jnp.zeros_like(r.hits)
     new_state = BucketState(
-        algorithm=jnp.where(is_token, jnp.int32(Algorithm.TOKEN_BUCKET),
-                            jnp.int32(Algorithm.LEAKY_BUCKET)),
+        algorithm=zsel(
+            r.algorithm,
+            jnp.where(is_token, jnp.int32(Algorithm.TOKEN_BUCKET),
+                      jnp.int32(Algorithm.LEAKY_BUCKET)),
+        ),
         limit=r.limit,
-        remaining=sel(zero64, te_rem, tn_rem, s.remaining, s.remaining),
-        remaining_f=sel(s.remaining_f * 0, s.remaining_f, s.remaining_f, le_remf, ln_remf),
-        duration=sel(zero64, r.duration, r.duration, r.duration, ln_duration),
-        created_at=sel(zero64, t_created, r.created_at, s.created_at, s.created_at),
-        updated_at=sel(zero64, s.updated_at, s.updated_at, b_upd, r.created_at),
-        burst=sel(zero64, s.burst, s.burst, burst, burst),
-        status=sel(jnp.zeros_like(s.status), te_status, UNDER, s.status, UNDER),
-        expire_at=sel(zero64, t_expire, tn_expire, le_expire, ln_expire),
-        in_use=sel(jnp.zeros_like(s.in_use), s.in_use | True, s.in_use | True,
-                   s.in_use | True, s.in_use | True),
+        remaining=zsel(
+            zs.remaining,
+            sel(zero64, te_rem, tn_rem, s.remaining, s.remaining)),
+        remaining_f=zsel(
+            jnp.zeros_like(s.remaining_f),
+            sel(s.remaining_f * 0, s.remaining_f, s.remaining_f, le_remf,
+                ln_remf)),
+        duration=zsel(
+            r.duration,
+            sel(zero64, r.duration, r.duration, r.duration, ln_duration)),
+        created_at=zsel(
+            zs.created_at,
+            sel(zero64, t_created, r.created_at, s.created_at,
+                s.created_at)),
+        updated_at=zsel(
+            r.created_at,
+            sel(zero64, s.updated_at, s.updated_at, b_upd, r.created_at)),
+        burst=zsel(r.burst, sel(zero64, s.burst, s.burst, burst, burst)),
+        status=zsel(
+            zs.status,
+            sel(jnp.zeros_like(s.status), te_status, UNDER, s.status,
+                UNDER)),
+        expire_at=zsel(
+            zs.expire_at,
+            sel(zero64, t_expire, tn_expire, le_expire, ln_expire)),
+        in_use=zsel(
+            jnp.ones_like(s.in_use),
+            sel(jnp.zeros_like(s.in_use), s.in_use | True, s.in_use | True,
+                s.in_use | True, s.in_use | True)),
+        tat=zsel(zs.tat, zero64),
+        prev_count=zsel(zs.prev_count, zero64),
     )
 
     resp = RespBatch(
-        status=sel(UNDER * jnp.ones_like(s.status), te_resp_status,
-                   tn_resp_status, le_resp_status, ln_resp_status),
+        status=zsel(
+            zr.status,
+            sel(UNDER * jnp.ones_like(s.status), te_resp_status,
+                tn_resp_status, le_resp_status, ln_resp_status)),
         limit=r.limit,
-        remaining=sel(r.limit, te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem),
-        reset_time=sel(zero64, rl_reset, tn_expire, le_resp_reset, ln_resp_reset),
-        over_limit=sel(
-            jnp.zeros_like(exists),
-            t_at_zero | t_over,
-            tn_over,
-            l_at_zero | l_over,
-            ln_over,
-        ),
+        remaining=zsel(
+            zr.remaining,
+            sel(r.limit, te_resp_rem, tn_rem, le_resp_rem, ln_resp_rem)),
+        reset_time=zsel(
+            zr.reset_time,
+            sel(zero64, rl_reset, tn_expire, le_resp_reset,
+                ln_resp_reset)),
+        over_limit=zsel(
+            zr.over_limit != 0,
+            sel(
+                jnp.zeros_like(exists),
+                t_at_zero | t_over,
+                tn_over,
+                l_at_zero | l_over,
+                ln_over,
+            )),
     )
     return new_state, resp

@@ -672,8 +672,12 @@ def build_group_plan(m: np.ndarray, n: int, capacity: int, now: int,
     created = join_i32_pair(
         m[R["created_at"], :n], m[R["created_at"] + 1, :n])
     alive_ok = (dur > 0) & (created >= now)
+    # Closed-form folds exist only for token/leaky; zoo duplicates
+    # (algorithm >= 2) keep the sequential program's size-1 units.
+    alg_ok = m[R["algorithm"], :n] <= int(Algorithm.LEAKY_BUCKET)
     follower = ~is_start & live
-    if np.any(follower & ~(eq_prev & known & hits_pos & beh_ok & alive_ok)):
+    if np.any(follower
+              & ~(eq_prev & known & hits_pos & beh_ok & alive_ok & alg_ok)):
         return None
 
     u = len(starts)
@@ -749,6 +753,8 @@ def build_layer_plan(m: np.ndarray, n: int, capacity: int, now: int,
         & hits_pos
         & ((m[R["behavior"], :nl] & NO_MERGE) == 0)
         & ((m[R["known"], :nl] != 0) | is_start)
+        # zoo lanes have no closed-form fold: size-1 units only
+        & (m[R["algorithm"], :nl] <= int(Algorithm.LEAKY_BUCKET))
     )
     unit_start = is_start | ~ok
     heads = np.flatnonzero(unit_start)
@@ -919,6 +925,8 @@ def _apply_merged_followers(
         & (reqs.hits > 0)
         & ((reqs.behavior & NO_MERGE) == 0)
         & (reqs.known | (rank == 0))
+        # zoo lanes (algorithm >= 2) have no closed-form fold
+        & (reqs.algorithm <= jnp.int32(Algorithm.LEAKY_BUCKET))
     )
     # A group merges only if every valid member is mergeable: one bad row
     # (different hits/limit/..., RESET, query) sends the whole group to the
@@ -1108,6 +1116,8 @@ def _sorted_merge_plan(reqs: ReqBatch, is_start: jnp.ndarray):
         # group heads are exempt from the known check (their transition
         # handles the new-item case); group-rank==0 IS is_start
         & (reqs.known | is_start)
+        # zoo lanes (algorithm >= 2) have no closed-form fold
+        & (reqs.algorithm <= jnp.int32(Algorithm.LEAKY_BUCKET))
     )
     unit_start = is_start | ~ok
     nxt = jnp.where(unit_start, idx, jnp.int32(b))
@@ -1409,26 +1419,34 @@ def make_install_fn(layout: str = "columns"):
 
     def install(state, cols: jnp.ndarray, now: jnp.ndarray):
         slot, algo, limit, remaining, status, duration, reset_time, valid = cols
-        is_token = algo == jnp.int64(0)
+        # Every integer-count algorithm (token bucket and the whole zoo)
+        # installs remaining into the int column; only leaky buckets route
+        # it through remaining_f.  A pushed zoo bucket restarts its
+        # window/TAT locally (tat/prev_count zero) — the counter value is
+        # the authoritative part of an owner push, the phase is not.
+        is_leaky = algo == jnp.int64(int(Algorithm.LEAKY_BUCKET))
         # Invalid rows aim one past the table and drop.  The sentinel must
         # stay < 2^31: GSPMD partitions the scatter with int32 index math,
         # and a 2^40 sentinel truncates to slot 0 on a sharded table.
         scat = jnp.where(valid != 0, slot, jnp.int64(state.capacity))
 
+        zero = jnp.zeros_like(limit)
         rows = BucketState(
             algorithm=algo.astype(jnp.int32),
             limit=limit,
-            remaining=jnp.where(is_token, remaining, jnp.int64(0)),
+            remaining=jnp.where(is_leaky, jnp.int64(0), remaining),
             remaining_f=jnp.where(
-                is_token, jnp.float64(0.0), remaining.astype(jnp.float64)
+                is_leaky, remaining.astype(jnp.float64), jnp.float64(0.0)
             ),
             duration=duration,
-            created_at=jnp.where(is_token, now, jnp.int64(0)),
-            updated_at=jnp.where(is_token, jnp.int64(0), now),
-            burst=jnp.where(is_token, jnp.int64(0), limit),
+            created_at=jnp.where(is_leaky, jnp.int64(0), now),
+            updated_at=jnp.where(is_leaky, now, jnp.int64(0)),
+            burst=jnp.where(is_leaky, limit, jnp.int64(0)),
             status=status.astype(jnp.int32),
             expire_at=reset_time,
             in_use=valid != 0,
+            tat=zero,
+            prev_count=zero,
         )
         return _scatter(state, scat, rows)
 
@@ -1438,14 +1456,15 @@ def make_install_fn(layout: str = "columns"):
 # Field order for full-state restore/readback matrices (Store hooks).
 ITEM_INT_ROWS = (
     "slot", "algorithm", "limit", "remaining", "duration", "created_at",
-    "updated_at", "burst", "status", "expire_at", "valid",
+    "updated_at", "burst", "status", "expire_at", "tat", "prev_count",
+    "valid",
 )
 
 
 def make_restore_fn(layout: str = "columns"):
     """Jitted scatter installing *full* item state — the read-through path
     (Store.Get on cache miss, reference algorithms.go:45-51) and the
-    Loader.Load restore.  ``ints`` is (11, B) int64 per ITEM_INT_ROWS;
+    Loader.Load restore.  ``ints`` is (13, B) int64 per ITEM_INT_ROWS;
     ``floats`` is (B,) float64 (leaky ``remaining_f``)."""
 
     _, _gather, _scatter = _layout_ops(layout)
@@ -1467,6 +1486,8 @@ def make_restore_fn(layout: str = "columns"):
             status=f["status"].astype(jnp.int32),
             expire_at=f["expire_at"],
             in_use=f["valid"] != 0,
+            tat=f["tat"],
+            prev_count=f["prev_count"],
         )
         return _scatter(state, scat, rows)
 
@@ -1476,7 +1497,7 @@ def make_restore_fn(layout: str = "columns"):
 def make_readback_fn(layout: str = "columns"):
     """Jitted gather of full item state at given slots — the write-through
     path (Store.OnChange after every mutation, algorithms.go:149-153).
-    Returns ((10, B) int64, (B,) float64).  Out-of-range (padding) slots
+    Returns ((12, B) int64, (B,) float64).  Out-of-range (padding) slots
     read zeros on the column layout and guard-row garbage on the row
     layout — callers must not read rows past their real batch."""
 
@@ -1502,6 +1523,8 @@ def make_readback_fn(layout: str = "columns"):
                 rows.burst,
                 rows.status.astype(jnp.int64),
                 rows.expire_at,
+                rows.tat,
+                rows.prev_count,
                 rows.in_use.astype(jnp.int64),
             ]
         )
@@ -1512,7 +1535,8 @@ def make_readback_fn(layout: str = "columns"):
 
 READBACK_ROWS = (
     "algorithm", "limit", "remaining", "duration", "created_at",
-    "updated_at", "burst", "status", "expire_at", "in_use",
+    "updated_at", "burst", "status", "expire_at", "tat", "prev_count",
+    "in_use",
 )
 
 
@@ -1535,11 +1559,21 @@ SNAP_FIELDS = (
 LEASE_SNAP_FIELDS = ("lease_budget", "lease_expire", "lease_gen")
 
 
+# Algorithm-zoo state columns (docs/algorithms.md): GCRA's theoretical
+# arrival time and the sliding window's previous-window count.  Like the
+# lease columns these are EXTRA snapshot keys so pre-zoo snapshots keep
+# loading (absent keys restore as zeros — a fresh window/TAT, which is
+# the safe reading).  Unlike the lease columns they live IN the device
+# table, so they ride the slim-transfer probe/select path via SNAP_WIDE.
+ZOO_SNAP_FIELDS = ("tat", "prev_count")
+
+
 # Wide (int64) snapshot fields, in SNAP_FIELDS order, minus the narrow
 # algorithm/status columns — the unit of the slim-transfer schema below.
+# The zoo columns append after the legacy seven (word offsets 20-23).
 SNAP_WIDE = (
     "limit", "remaining", "duration", "created_at", "updated_at",
-    "burst", "expire_at",
+    "burst", "expire_at", "tat", "prev_count",
 )
 SNAP_CHUNK = 1 << 21  # live rows per export D2H chunk (~44-64 MB each)
 
@@ -1601,9 +1635,9 @@ def _jitted_snap_probe():
 
 @functools.lru_cache(maxsize=None)
 def _jitted_snap_select(hi_mask: tuple):
-    """(ROW_USED, w) words → (W, w) transfer matrix: the 7 lo words, the
-    hi words the chunk's probe proved necessary, the 3 remaining_f parts,
-    and one packed algorithm|status|in_use word."""
+    """(ROW_USED, w) words → (W, w) transfer matrix: the SNAP_WIDE lo
+    words, the hi words the chunk's probe proved necessary, the 3
+    remaining_f parts, and one packed algorithm|status|in_use word."""
     O = rowtable.FIELD_OFFSETS
 
     def f(m):
@@ -1670,6 +1704,10 @@ def snapshot_from_items(items: Sequence[dict]) -> dict:
     for f in SNAP_FIELDS:
         dt = np.float64 if f == "remaining_f" else np.int64
         snap[f] = np.asarray([it[f] for it in items], dt)
+    # Zoo columns default to zero for legacy items (pre-zoo Loader
+    # sources never mention them).
+    for f in ZOO_SNAP_FIELDS:
+        snap[f] = np.asarray([it.get(f, 0) for it in items], np.int64)
     return snap
 
 
@@ -1679,12 +1717,15 @@ def items_from_snapshot(snap: dict) -> List[dict]:
     offsets = snap["key_offsets"]
     blob = snap["key_blob"]
     n = len(offsets) - 1
-    cols = {f: snap[f].tolist() for f in SNAP_FIELDS}
+    fields = SNAP_FIELDS + ZOO_SNAP_FIELDS
+    cols = {
+        f: snap[f].tolist() if f in snap else [0] * n for f in fields
+    }
     keys = [
         bytes(blob[offsets[j] : offsets[j + 1]]).decode() for j in range(n)
     ]
     return [
-        {"key": keys[j], **{f: cols[f][j] for f in SNAP_FIELDS}}
+        {"key": keys[j], **{f: cols[f][j] for f in fields}}
         for j in range(n)
     ]
 
@@ -1702,6 +1743,7 @@ def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
         for name in (
             "algorithm", "limit", "remaining", "remaining_f", "duration",
             "created_at", "updated_at", "burst", "status", "expire_at",
+            "tat", "prev_count",
         )
     }
     return [
@@ -1717,6 +1759,8 @@ def items_from_columns(keys: List[bytes], st, live: np.ndarray) -> List[dict]:
             "burst": int(cols["burst"][j]),
             "status": int(cols["status"][j]),
             "expire_at": int(cols["expire_at"][j]),
+            "tat": int(cols["tat"][j]),
+            "prev_count": int(cols["prev_count"][j]),
         }
         for j in range(len(live))
     ]
@@ -2381,13 +2425,21 @@ class TickEngine:
         measured) — unwarmed, that lands on the first live request, blows
         the 500ms peer batch_timeout, and triggers forward retries that
         double-count hits."""
+        warm_sequential = jax.default_backend() == "tpu"
         for w in self._widths:
             m = np.zeros((REQ32_ROWS, w), np.int32)
             m[REQ32_INDEX["slot"]] = self.capacity
-            self.state, resp = self._tick(
-                self.state, jnp.asarray(m), jnp.int64(0)
-            )
-            np.asarray(resp)
+            if warm_sequential:
+                # The sequential chained-unit program only serves
+                # adversarial duplicate shapes; like the layered warmup
+                # below, eager-compiling it is a serving chip's live-
+                # deadline concern — on the CPU backend (tests, the fast
+                # CI gate) most engines never tick it and lazy is the
+                # right trade.
+                self.state, resp = self._tick(
+                    self.state, jnp.asarray(m), jnp.int64(0)
+                )
+                np.asarray(resp)
             self.state, resp = self._tick32(
                 self.state, jnp.asarray(m), jnp.int64(0)
             )
@@ -2945,7 +2997,8 @@ class TickEngine:
                 (
                     (slot, item["algorithm"], item["limit"], item["remaining"],
                      item["duration"], item["created_at"], item["updated_at"],
-                     item["burst"], item["status"], item["expire_at"], 1),
+                     item["burst"], item["status"], item["expire_at"],
+                     item.get("tat", 0), item.get("prev_count", 0), 1),
                     item.get("remaining_f", 0.0),
                 )
             )
@@ -3232,6 +3285,8 @@ class TickEngine:
                     "burst": int(f["burst"]),
                     "status": int(f["status"]),
                     "expire_at": int(f["expire_at"]),
+                    "tat": int(f["tat"]),
+                    "prev_count": int(f["prev_count"]),
                 },
             )
 
@@ -3410,6 +3465,7 @@ class TickEngine:
                     )
                     for f in SNAP_FIELDS
                 },
+                **{f: np.zeros(0, np.int64) for f in ZOO_SNAP_FIELDS},
                 **{f: np.zeros(0, np.int64) for f in LEASE_SNAP_FIELDS},
             }
             if n == 0:
@@ -3457,7 +3513,9 @@ class TickEngine:
                 return self._export_with_cold(empty, dirty_only)
             blob, offsets = self.slots.keys_blob(live)
             snap: dict = {"key_blob": blob, "key_offsets": offsets}
-            for name in SNAP_FIELDS:
+            # The zoo columns decode from the same chunks (they sit in
+            # SNAP_WIDE) and export as extra keys beside SNAP_FIELDS.
+            for name in SNAP_FIELDS + ZOO_SNAP_FIELDS:
                 snap[name] = np.concatenate([c[name] for c in chunks])
             # Lease columns ride as extra snapshot keys gathered at the
             # same live slots (order-aligned with the key blob).  One
@@ -3495,7 +3553,9 @@ class TickEngine:
         base = int(off1[-1]) if len(off1) else 0
         snap["key_blob"] = bytes(snap["key_blob"]) + blob2
         snap["key_offsets"] = np.concatenate([off1, offs2[1:] + base])
-        for f in SNAP_FIELDS:
+        for f in SNAP_FIELDS + ZOO_SNAP_FIELDS:
+            # The cold tier stores the zoo columns too (COLD_FIELDS),
+            # so demoted zoo state survives the round trip.
             snap[f] = np.concatenate([np.asarray(snap[f]), ccols[f]])
         for f in LEASE_SNAP_FIELDS:
             # Cold rows hold no delegation (demotion targets idle slots;
@@ -3534,6 +3594,14 @@ class TickEngine:
             if n == 0:
                 return
             cols = {f: np.asarray(snap[f]) for f in SNAP_FIELDS}
+            # Pre-zoo snapshots lack the zoo state columns: restore them
+            # as zeros — a fresh window/TAT, the safe reading (see
+            # ZOO_SNAP_FIELDS).
+            for f in ZOO_SNAP_FIELDS:
+                cols[f] = (
+                    np.asarray(snap[f]) if f in snap
+                    else np.zeros(n, np.int64)
+                )
             # Pre-lease snapshots simply lack the lease keys: restore
             # them as no-delegation (zeros) rather than failing.
             has_lease = all(f in snap for f in LEASE_SNAP_FIELDS)
@@ -3561,7 +3629,7 @@ class TickEngine:
                 offsets = np.asarray(offsets, np.int64)
                 self.cold.put_columns(
                     [bytes(blob[offsets[j] : offsets[j + 1]]) for j in over],
-                    {f: cols[f][over] for f in SNAP_FIELDS},
+                    {f: cols[f][over] for f in SNAP_FIELDS + ZOO_SNAP_FIELDS},
                     now,
                 )
             sel = np.flatnonzero(slots >= 0)  # full table: drop the tail

@@ -57,8 +57,9 @@ from gubernator_tpu.ops.transition32 import (
 I32 = jnp.int32
 F32 = jnp.float32
 
-# 24 table words ride the MXU transpose: ROW_USED (20) rounded up to a
-# multiple of 8 sublanes.  The transposed block is (TW, C).
+# 24 table words ride the MXU transpose: ROW_USED (24 — 20 legacy words
+# plus the zoo's tat/prev_count pairs), already a multiple of 8
+# sublanes.  The transposed block is (TW, C).
 TW = 24
 _VMEM = jaxcompat.pallas_tpu_compiler_params(
     vmem_limit_bytes=100 * 1024 * 1024)
@@ -128,6 +129,8 @@ def _pstate_from_T(T):
         status=row(O["status"]),
         expire_at=pair("expire_at"),
         in_use=row(O["in_use"]) != 0,
+        tat=pair("tat"),
+        prev_count=pair("prev_count"),
     )
 
 
@@ -146,10 +149,14 @@ def _pstate_to_T(s: PState):
         s.status,
         s.expire_at.lo, s.expire_at.hi,
         s.in_use.astype(I32),
+        s.tat.lo, s.tat.hi,
+        s.prev_count.lo, s.prev_count.hi,
     ]
     c = rows[0].shape[1]
-    pad = jnp.zeros((TW - len(rows), c), I32)
-    return jnp.concatenate(rows + [pad], axis=0)
+    if len(rows) < TW:
+        pad = jnp.zeros((TW - len(rows), c), I32)
+        rows = rows + [pad]
+    return jnp.concatenate(rows, axis=0)
 
 
 def _preq_from_rows(mr):

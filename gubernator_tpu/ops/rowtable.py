@@ -1,7 +1,7 @@
 """Row-major bucket table with Pallas per-row DMA gather/scatter.
 
 The column layout (buckets.py) bounds a tick by ~40 random single-word
-HBM accesses per decision (20 stored columns gathered + scattered), which
+HBM accesses per decision (24 stored columns gathered + scattered), which
 measures ~100-200M words/s on a v5e chip — a hard ~3M decisions/s/chip
 ceiling regardless of batch size.  This module stores the whole bucket
 row contiguously — one (capacity+1, 128) int32 array, 512 B per slot —
@@ -10,7 +10,7 @@ of async copies, K in flight, 4 issued per loop step).  Measured on v5e:
 ~3-25 ns/row scatter and ~25-50 ns/row gather, capacity-independent —
 about 6-8x the column layout's gather+scatter cost at 32k-request ticks.
 
-Layout (int32 words within a row; 20 used, the rest spare):
+Layout (int32 words within a row; 24 used, the rest spare):
   word 0        algorithm
   words 1-2     limit        (int64 as lo,hi — same bitcast as buckets.py)
   words 3-4     remaining
@@ -22,6 +22,8 @@ Layout (int32 words within a row; 20 used, the rest spare):
   word 16       status
   words 17-18   expire_at
   word 19       in_use
+  words 20-21   tat          (GCRA theoretical arrival time)
+  words 22-23   prev_count   (sliding-window previous-window count)
 
 Row ``capacity`` is a guard row: masked scatter lanes aim there (the row
 equivalent of the column path's ``mode="drop"`` sentinel), and gathers of
@@ -30,7 +32,7 @@ they do for the column path's zero-fill.
 
 Why 128 words: Mosaic requires HBM<->VMEM DMA slices to be 128-element
 aligned in the lane dimension, so 512 B is the minimum int32 row.  The
-6x space cost vs the 20 used words is the price of one-DMA rows; engines
+5x space cost vs the 24 used words is the price of one-DMA rows; engines
 fall back to the column layout for tables too big to afford it (see
 engine.make_layout_choice).
 
@@ -141,7 +143,7 @@ _o = 0
 for _f in STATE_DTYPES:
     FIELD_OFFSETS[_f] = _o
     _o += _field_words(_f)
-ROW_USED = _o  # 20
+ROW_USED = _o  # 24
 assert ROW_USED <= ROW_W
 
 

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from gubernator_tpu.ops import i64pair as p64
-from gubernator_tpu.types import Behavior
+from gubernator_tpu.types import Algorithm, Behavior
 from gubernator_tpu.ops.transition32 import (
     preq_from_compact,
     pstate_from_matrix,
@@ -438,10 +438,15 @@ def make_sorted_tick32_rows_fn(capacity: int, layout: str = "columns",
             int(Behavior.RESET_REMAINING)
             | int(Behavior.DURATION_IS_GREGORIAN))
         hits_pos = p64.gt(rq.hits, p64.const(0, slot))
+        # Closed-form duplicate folds exist only for token/leaky; zoo
+        # lanes (algorithm >= 2) stay size-1 units and transition
+        # sequentially within the same dispatch.
+        legacy_alg = rq.algorithm <= jnp.int32(Algorithm.LEAKY_BUCKET)
         ok = (
             rq.valid & same_as_prev & hits_pos
             & ((rq.behavior & NO_MERGE) == 0)
             & (rq.known | is_start)
+            & legacy_alg
         )
         unit_start = is_start | ~ok
         nxt = jnp.where(unit_start, idx, jnp.int32(b))

@@ -27,6 +27,8 @@ import gubernator_tpu.jaxinit  # noqa: F401  (x64 + compile cache before jax use
 import jax.numpy as jnp
 from jax import lax
 
+from gubernator_tpu.algos import ZOO_MIN
+from gubernator_tpu.algos import table as zoo_table
 from gubernator_tpu.ops import i64pair as p64
 from gubernator_tpu.ops import tfloat as tf
 from gubernator_tpu.ops.i64pair import I64
@@ -51,6 +53,8 @@ class PState(NamedTuple):
     status: jnp.ndarray      # i32
     expire_at: I64
     in_use: jnp.ndarray      # bool
+    tat: I64                 # GCRA theoretical arrival time
+    prev_count: I64          # sliding-window previous-window count
 
 
 class PReq(NamedTuple):
@@ -241,6 +245,20 @@ def transition32(now: I64, s: PState, r: PReq) -> tuple[PState, PResp]:
     ln_expire = p64.add(r.created_at, ln_duration)
 
     # ------------------------------------------------------------------
+    # ALGORITHM ZOO (gubernator_tpu/algos): the same policy table the
+    # x64 oracle folds in, instantiated on the parts backend.
+    # ------------------------------------------------------------------
+    is_zoo = r.algorithm >= jnp.int32(ZOO_MIN)
+    zs, zr = zoo_table.zoo_transitions(
+        zoo_table.PartsOps, s, r, exists, reset_b, drain_b)
+
+    def z64(zoo_v, legacy_v):
+        return p64.select(is_zoo, zoo_v, legacy_v)
+
+    def z32(zoo_v, legacy_v):
+        return jnp.where(is_zoo, zoo_v, legacy_v)
+
+    # ------------------------------------------------------------------
     # Select per-request outcome (token-reset / token-exist / token-new /
     # leaky-exist / leaky-new)
     # ------------------------------------------------------------------
@@ -266,42 +284,65 @@ def transition32(now: I64, s: PState, r: PReq) -> tuple[PState, PResp]:
     false_ = jnp.zeros(shape, I32)
 
     new_state = PState(
-        algorithm=jnp.where(
-            is_token,
-            jnp.int32(Algorithm.TOKEN_BUCKET),
-            jnp.int32(Algorithm.LEAKY_BUCKET),
-        ),
+        algorithm=z32(
+            r.algorithm,
+            jnp.where(
+                is_token,
+                jnp.int32(Algorithm.TOKEN_BUCKET),
+                jnp.int32(Algorithm.LEAKY_BUCKET),
+            )),
         limit=r.limit,
-        remaining=sel64(zero, te_rem, tn_rem, s.remaining, s.remaining),
-        remaining_f=selt(
-            zero_t, s.remaining_f, s.remaining_f, le_remf, ln_remf),
-        duration=sel64(zero, r.duration, r.duration, r.duration, ln_duration),
-        created_at=sel64(
-            zero, t_created, r.created_at, s.created_at, s.created_at),
-        updated_at=sel64(
-            zero, s.updated_at, s.updated_at, b_upd, r.created_at),
-        burst=sel64(zero, s.burst, s.burst, burst, burst),
-        status=sel32(
-            jnp.zeros(shape, I32), te_status, UNDER, s.status, UNDER),
-        expire_at=sel64(zero, t_expire, tn_expire, le_expire, ln_expire),
-        in_use=sel32(false_, true_, true_, true_, true_) != 0,
+        remaining=z64(
+            zs.remaining,
+            sel64(zero, te_rem, tn_rem, s.remaining, s.remaining)),
+        remaining_f=tf.select(
+            is_zoo, zero_t,
+            selt(zero_t, s.remaining_f, s.remaining_f, le_remf, ln_remf)),
+        duration=z64(
+            r.duration,
+            sel64(zero, r.duration, r.duration, r.duration, ln_duration)),
+        created_at=z64(
+            zs.created_at,
+            sel64(zero, t_created, r.created_at, s.created_at,
+                  s.created_at)),
+        updated_at=z64(
+            r.created_at,
+            sel64(zero, s.updated_at, s.updated_at, b_upd, r.created_at)),
+        burst=z64(r.burst, sel64(zero, s.burst, s.burst, burst, burst)),
+        status=z32(
+            zs.status,
+            sel32(jnp.zeros(shape, I32), te_status, UNDER, s.status,
+                  UNDER)),
+        expire_at=z64(
+            zs.expire_at,
+            sel64(zero, t_expire, tn_expire, le_expire, ln_expire)),
+        in_use=z32(true_, sel32(false_, true_, true_, true_, true_)) != 0,
+        tat=z64(zs.tat, zero),
+        prev_count=z64(zs.prev_count, zero),
     )
 
     resp = PResp(
-        status=sel32(
-            jnp.full(shape, UNDER), te_resp_status, tn_resp_status,
-            le_resp_status, ln_resp_status),
-        remaining=sel64(r.limit, te_resp_rem, tn_rem, le_resp_rem,
-                        ln_resp_rem),
-        reset_time=sel64(zero, rl_reset, tn_expire, le_resp_reset,
-                         ln_resp_reset),
-        over_limit=sel32(
-            false_,
-            (t_at_zero | t_over).astype(I32),
-            tn_over.astype(I32),
-            (l_at_zero | l_over).astype(I32),
-            ln_over.astype(I32),
-        ) != 0,
+        status=z32(
+            zr.status,
+            sel32(jnp.full(shape, UNDER), te_resp_status, tn_resp_status,
+                  le_resp_status, ln_resp_status)),
+        remaining=z64(
+            zr.remaining,
+            sel64(r.limit, te_resp_rem, tn_rem, le_resp_rem,
+                  ln_resp_rem)),
+        reset_time=z64(
+            zr.reset_time,
+            sel64(zero, rl_reset, tn_expire, le_resp_reset,
+                  ln_resp_reset)),
+        over_limit=z32(
+            zr.over_limit,
+            sel32(
+                false_,
+                (t_at_zero | t_over).astype(I32),
+                tn_over.astype(I32),
+                (l_at_zero | l_over).astype(I32),
+                ln_over.astype(I32),
+            )) != 0,
     )
     return new_state, resp
 
@@ -377,6 +418,8 @@ def pstate_from_matrix(m: jnp.ndarray) -> PState:
         status=m[..., O["status"]],
         expire_at=pair("expire_at"),
         in_use=m[..., O["in_use"]] != 0,
+        tat=pair("tat"),
+        prev_count=pair("prev_count"),
     )
 
 
@@ -398,6 +441,8 @@ def pstate_to_matrix(s: PState) -> jnp.ndarray:
         s.status,
         s.expire_at.lo, s.expire_at.hi,
         s.in_use.astype(I32),
+        s.tat.lo, s.tat.hi,
+        s.prev_count.lo, s.prev_count.hi,
     ]
     mat = jnp.stack(cols, axis=-1)
     b = mat.shape[:-1]
@@ -426,6 +471,8 @@ def pstate_gather_columns(state, idx: jnp.ndarray) -> PState:
         status=state.status[idx],
         expire_at=pair("expire_at"),
         in_use=state.in_use[idx],
+        tat=pair("tat"),
+        prev_count=pair("prev_count"),
     )
 
 
@@ -459,6 +506,10 @@ def pstate_scatter_columns(state, idx: jnp.ndarray, rows: PState):
         expire_at=(put(state.expire_at[0], rows.expire_at.lo),
                    put(state.expire_at[1], rows.expire_at.hi)),
         in_use=put(state.in_use, rows.in_use),
+        tat=(put(state.tat[0], rows.tat.lo),
+             put(state.tat[1], rows.tat.hi)),
+        prev_count=(put(state.prev_count[0], rows.prev_count.lo),
+                    put(state.prev_count[1], rows.prev_count.hi)),
     )
 
 
@@ -512,7 +563,11 @@ def merged_fold32(now: I64, new_s: PState, r: PReq, count: jnp.ndarray
     q = p64.div_floor_pos(base_pos, h)
     li = p64.from_i32(count - 1)
     alive = p64.le(now, new_s.expire_at)
-    fold = (count > 1) & alive & r.valid
+    # Closed-form fold is only valid for the token/leaky pair; the host
+    # group planner never groups zoo lanes (engine gates eligibility on
+    # algorithm <= LEAKY_BUCKET), this mask is defense in depth.
+    legacy = r.algorithm <= jnp.int32(Algorithm.LEAKY_BUCKET)
+    fold = (count > 1) & alive & r.valid & legacy
 
     qh = p64.mul(q, h)
     residue = p64.sub(base, qh)          # base - q*h, >= 0

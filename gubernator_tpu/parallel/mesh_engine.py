@@ -528,17 +528,23 @@ class MeshTickEngine:
         sharding is a new jit signature that re-traces every warmed
         program (~0.6 s each; the ShardedOps.trace_counts pin in
         test_mesh_engine holds this)."""
-        m = np.zeros((REQ32_ROWS, self.max_batch), np.int32)
-        m[REQ32_INDEX["slot"]] = self.capacity
-        offs = self.ragged.offsets(np.zeros(self.n_shards, np.int64))
-        self.state, resp = self.ops.tick_ragged(
-            self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
-        )
-        np.asarray(resp)  # warm the response D2H path
-        self.state, resp = self.ops.run_tick_ragged_unique(
-            self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
-        )
-        np.asarray(resp)
+        if jax.default_backend() == "tpu":
+            # Eager tick compiles are a serving chip's live-deadline
+            # concern (see TickEngine._warmup): on the CPU backend
+            # (tests, the fast CI gate) each shard_map trace costs
+            # seconds per engine and most tests tick only one of the
+            # two programs — lazy is the right trade.
+            m = np.zeros((REQ32_ROWS, self.max_batch), np.int32)
+            m[REQ32_INDEX["slot"]] = self.capacity
+            offs = self.ragged.offsets(np.zeros(self.n_shards, np.int64))
+            self.state, resp = self.ops.tick_ragged(
+                self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
+            )
+            np.asarray(resp)  # warm the response D2H path
+            self.state, resp = self.ops.run_tick_ragged_unique(
+                self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
+            )
+            np.asarray(resp)
         cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
         self.state = self.ops.install(
             self.state, self.ops.put3(cols), jnp.int64(0)
@@ -960,7 +966,8 @@ class MeshTickEngine:
                     (int(slots[j]), item["algorithm"], item["limit"],
                      item["remaining"], item["duration"], item["created_at"],
                      item["updated_at"], item["burst"], item["status"],
-                     item["expire_at"], 1),
+                     item["expire_at"], item.get("tat", 0),
+                     item.get("prev_count", 0), 1),
                     item.get("remaining_f", 0.0),
                 )
             )
@@ -1025,6 +1032,8 @@ class MeshTickEngine:
                         "burst": int(f["burst"]),
                         "status": int(f["status"]),
                         "expire_at": int(f["expire_at"]),
+                        "tat": int(f["tat"]),
+                        "prev_count": int(f["prev_count"]),
                     },
                 )
 
@@ -1165,7 +1174,8 @@ class MeshTickEngine:
                     k = len(part)
                     ints[s, 0, :k] = lslots[part]
                     for r, name in enumerate(ITEM_INT_ROWS[1:-1], start=1):
-                        ints[s, r, :k] = [live[j][name] for j in part]
+                        # .get: pre-zoo snapshot items lack tat/prev_count.
+                        ints[s, r, :k] = [live[j].get(name, 0) for j in part]
                     ints[s, -1, :k] = 1
                     floats[s, :k] = [live[j]["remaining_f"] for j in part]
                 self.state = self.ops.restore(

@@ -44,8 +44,13 @@ from gubernator_tpu.parallel.hashring import (
 from gubernator_tpu.service.global_manager import GlobalManager
 from gubernator_tpu.service.peer_client import PeerClient
 from gubernator_tpu.service.tickloop import TickLoop
+import numpy as np
+
+from gubernator_tpu.algos import algorithm_error, invalid_algorithm_mask
 from gubernator_tpu.types import (
+    ALGORITHM_MAX,
     MAX_BATCH_SIZE,
+    Algorithm,
     Behavior,
     GlobalUpdate,
     HealthCheckResponse,
@@ -483,6 +488,13 @@ class V1Instance:
                 self.metrics.check_error_counter.labels(error="Invalid request").inc()
                 out[i] = RateLimitResponse(error="field 'namespace' cannot be empty")
                 continue
+            if invalid_algorithm_mask(int(req.algorithm)):
+                # Reject unknown enum values here: past the edge, the
+                # kernels' branchless per-lane dispatch would silently
+                # run them as token-bucket (algos/__init__.py).
+                self.metrics.check_error_counter.labels(error="Invalid request").inc()
+                out[i] = RateLimitResponse(error=algorithm_error(req.algorithm))
+                continue
             if req.created_at is None or req.created_at == 0:
                 req.created_at = created_at
             if self.conf.behaviors.force_global:
@@ -614,6 +626,7 @@ class V1Instance:
             self.metrics.getratelimit_counter.labels(calltype="local").inc(
                 len(cols) - len(errors)
             )
+            self._count_algorithms(cols.algorithm)
             from gubernator_tpu.ops.engine import masked_over_limit
 
             over = masked_over_limit(mat, errors)
@@ -644,6 +657,7 @@ class V1Instance:
             self.metrics.func_duration.labels(
                 name="V1Instance.getLocalRateLimit"
             ).observe(time.perf_counter() - t0)
+            self._count_algorithms([r.algorithm for r in reqs])
             for req, resp in zip(reqs, resps):
                 if has_behavior(req.behavior, Behavior.GLOBAL):
                     self.global_mgr.queue_update(req)
@@ -654,6 +668,22 @@ class V1Instance:
             return resps
 
         return asyncio.ensure_future(run())
+
+    def _count_algorithms(self, algorithms) -> None:
+        """Per-algorithm traffic split (gubernator_tpu_algorithm_requests).
+
+        ``algorithms`` is host-side (a list or the batch's numpy column —
+        never a device value).  Out-of-range lanes were rejected with
+        per-item errors at the edge and are skipped here.
+        """
+        a = np.asarray(algorithms, np.int64)
+        ok = (a >= 0) & (a <= int(ALGORITHM_MAX))
+        counts = np.bincount(a[ok], minlength=int(ALGORITHM_MAX) + 1)
+        for v, c in enumerate(counts):
+            if c:
+                self.metrics.algorithm_requests.labels(
+                    algorithm=Algorithm(v).name.lower()
+                ).inc(int(c))
 
     async def apply_local(
         self, reqs: List[RateLimitRequest]

@@ -32,12 +32,19 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 # Field schema shared with the engine's columnar snapshot (the Loader v2
-# wire format; engine.SNAP_FIELDS).  Duplicated as a literal to keep this
-# package importable without jax.
+# wire format; engine.SNAP_FIELDS + engine.ZOO_SNAP_FIELDS).  Duplicated
+# as a literal to keep this package importable without jax.  The trailing
+# zoo columns (tat / prev_count, docs/algorithms.md) default to zero when
+# a caller's column dict omits them (pre-zoo SSD slabs, legacy stores).
 COLD_FIELDS = (
     "algorithm", "limit", "remaining", "remaining_f", "duration",
     "created_at", "updated_at", "burst", "status", "expire_at",
+    "tat", "prev_count",
 )
+
+# The subset that legacy (pre-zoo) payloads may omit — decoders zero-fill
+# these instead of failing (mirrors engine.ZOO_SNAP_FIELDS).
+ZOO_COLD_FIELDS = ("tat", "prev_count")
 
 _MIN_ALLOC = 256
 
@@ -209,6 +216,12 @@ class ColdStore:
         Returns the number of rows actually demoted."""
         if not keys:
             return 0
+        missing = [f for f in COLD_FIELDS if f not in cols]
+        if missing:
+            # Legacy callers (pre-zoo slabs, old stores) omit the zoo
+            # columns; zero is the safe restore (fresh window/TAT).
+            zeros = np.zeros(len(keys), np.int64)
+            cols = {**cols, **{f: zeros for f in missing}}
         expire = np.asarray(cols["expire_at"], np.int64)
         keep = expire >= now
         shed: List[Tuple[List[bytes], Dict[str, np.ndarray]]] = []

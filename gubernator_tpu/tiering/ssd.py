@@ -74,7 +74,7 @@ from gubernator_tpu.persistence.snapshot import (
     _HEADER, MAGIC, read_records, write_record,
 )
 from gubernator_tpu.resilience.supervisor import spawn_supervised_thread
-from gubernator_tpu.tiering.coldstore import COLD_FIELDS
+from gubernator_tpu.tiering.coldstore import COLD_FIELDS, ZOO_COLD_FIELDS
 from gubernator_tpu.utils.hotpath import hot_path
 
 log = logging.getLogger("gubernator.tiering.ssd")
@@ -108,11 +108,20 @@ def _encode_batch(keys: List[bytes], cols: Dict[str, np.ndarray]) -> bytes:
 
 
 def _decode_batch(payload: bytes) -> Tuple[List[bytes], Dict[str, np.ndarray]]:
-    """Inverse of :func:`_encode_batch`."""
+    """Inverse of :func:`_encode_batch`.  Slabs written before the
+    algorithm zoo lack the zoo columns: zero-fill them (fresh
+    window/TAT) so old slab files keep loading."""
     with np.load(io.BytesIO(payload)) as z:
         blob = z["key_blob"].tobytes()
         offsets = z["key_offsets"]
-        cols = {f: z[f] for f in COLD_FIELDS}
+        n = len(offsets) - 1
+        cols = {
+            f: (
+                z[f] if f in z.files
+                else np.zeros(n, _field_dtype(f))
+            )
+            for f in COLD_FIELDS
+        }
     keys = [
         blob[int(offsets[i]): int(offsets[i + 1])]
         for i in range(len(offsets) - 1)
@@ -327,6 +336,12 @@ class SsdStore:
         is full — backpressure, never unbounded RAM."""
         if not keys:
             return 0
+        missing = [f for f in COLD_FIELDS if f not in cols]
+        if missing:
+            # Legacy callers omit the zoo columns; zero-fill (see
+            # _decode_batch).
+            zeros = np.zeros(len(keys), np.int64)
+            cols = {**cols, **{f: zeros for f in missing}}
         expire = cols["expire_at"]
         keep = np.flatnonzero(expire >= now)
         if len(keep) == 0:
@@ -610,7 +625,13 @@ class SsdStore:
             return
         keys = [it["key"].encode() for it in items]
         cols = {
-            f: np.asarray([it[f] for it in items], _field_dtype(f))
+            f: np.asarray(
+                [
+                    it.get(f, 0) if f in ZOO_COLD_FIELDS else it[f]
+                    for it in items
+                ],
+                _field_dtype(f),
+            )
             for f in COLD_FIELDS
         }
         self.put_columns(keys, cols, now=0)

@@ -88,6 +88,7 @@ def columns_from_pb(ms) -> tuple:
     """
     import numpy as np
 
+    from gubernator_tpu.algos import algorithm_error, invalid_algorithm_mask
     from gubernator_tpu.ops.reqcols import CREATED_UNSET, ReqColumns, pack_blob
     from gubernator_tpu.types import Behavior
 
@@ -113,6 +114,13 @@ def columns_from_pb(ms) -> tuple:
         elif nm == "":
             errors[i] = "field 'namespace' cannot be empty"
         else:
+            if invalid_algorithm_mask(int(m.algorithm)):
+                # Unknown enum values must NOT fall through the kernels'
+                # branchless dispatch as token-bucket (algos/__init__.py).
+                errors[i] = algorithm_error(m.algorithm)
+            # The key is well-formed even when the algorithm is not —
+            # keep it in the blob (fastwire.parse_req parity; batches
+            # with errors never reach the columns tick path).
             keys[i] = (nm + "_" + uk).encode()
         hits[i] = m.hits
         limit[i] = m.limit

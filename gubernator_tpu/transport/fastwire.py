@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from gubernator_tpu import native as native_mod
+from gubernator_tpu.algos import algorithm_error, invalid_algorithm_mask
 from gubernator_tpu.ops.reqcols import (
     CREATED_UNSET,
     ColumnArena,
@@ -168,6 +169,14 @@ def parse_req(
                 if flags[i] & _KEY_EMPTY
                 else "field 'namespace' cannot be empty"
             )
+    # Out-of-range algorithm values must fail loudly here: the kernels'
+    # branchless per-lane dispatch would otherwise silently run an
+    # unknown enum as a token bucket (algos/__init__.py).
+    bad_algo = invalid_algorithm_mask(algorithm)
+    # guber: allow-G001(algorithm is host numpy, never a device value)
+    if bool(bad_algo.any()):
+        for i in np.flatnonzero(bad_algo):
+            errors.setdefault(int(i), algorithm_error(algorithm[i]))
     # guber: allow-G001(flags/behavior are host numpy, never device)
     special = bool((flags & _HAS_METADATA).any()) or bool(
         (behavior & _GLOBAL).any()

@@ -16,6 +16,17 @@ from typing import Dict, Optional
 class Algorithm(enum.IntEnum):
     TOKEN_BUCKET = 0
     LEAKY_BUCKET = 1
+    # Algorithm-zoo extensions (gubernator_tpu/algos/): same SoA table,
+    # same dispatch, selected per-lane by this column.
+    SLIDING_WINDOW = 2
+    GCRA = 3
+    CONCURRENCY = 4
+
+
+# Highest wire-valid Algorithm value; anything outside [0, ALGORITHM_MAX]
+# is rejected at the edge with INVALID_ARGUMENT (never silently treated
+# as token-bucket by the select tree).
+ALGORITHM_MAX = max(Algorithm)
 
 
 class Behavior(enum.IntFlag):

@@ -330,6 +330,17 @@ class Metrics:
             "Requests applied per device tick.",
             registry=reg,
         )
+        # Algorithm zoo (docs/algorithms.md): per-policy traffic split of
+        # the mixed-policy device table.  Label \"algorithm\" is the enum
+        # name (token_bucket, leaky_bucket, sliding_window, gcra,
+        # concurrency); out-of-range wire values are rejected at the edge
+        # and never counted here.
+        self.algorithm_requests = Counter(
+            "gubernator_tpu_algorithm_requests",
+            "Rate-limit items accepted for ticking, by algorithm.",
+            ["algorithm"],
+            registry=reg,
+        )
         # GLOBAL mesh reconcile telemetry: steps this daemon drove, mesh
         # programs those steps launched, and dense-fallback steps.  One
         # dispatch per step is the fused sparse/dense normal case; 2 means

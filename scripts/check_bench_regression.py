@@ -100,6 +100,14 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #   ssd_promote_batches_per_miss_tick 1.0 — the miss path's third hop is
 #                                        ONE batched slab lookup per miss
 #                                        tick, never per-key reads
+#   mixed_algo_parity_errors       0   — zoo-lane decisions (sliding
+#                                        window, GCRA, concurrency) are
+#                                        bit-identical to the scalar
+#                                        references (docs/algorithms.md)
+#   mixed_algo_dispatches_per_step 1.0 — a window mixing all five
+#                                        algorithms stays ONE device
+#                                        dispatch, never per-algorithm
+#                                        sub-batches
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -127,6 +135,8 @@ COUNT_KEYS = (
     "multiproc_parity_errors",
     "multiproc_double_served",
     "multiproc_dropped_acked",
+    "mixed_algo_parity_errors",
+    "mixed_algo_dispatches_per_step",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -229,6 +239,9 @@ ABSOLUTE_MAX_KEYS = {
     # The SSD miss hop is ONE batched slab lookup per miss tick — above
     # 1.0 the tier re-introduced per-key reads (docs/tiering.md).
     "ssd_promote_batches_per_miss_tick": 1.0,
+    # A mixed-policy window is ONE tick program — above 1.0 the zoo
+    # re-introduced per-algorithm sub-batches (docs/algorithms.md).
+    "mixed_algo_dispatches_per_step": 1.0,
     # The SSD churn rung's 8x working set lives on flash: resident-set
     # growth across the rung stays bounded by the two RAM tiers no
     # matter what the baseline measured.
@@ -270,6 +283,7 @@ ABSOLUTE_ZERO_KEYS = (
     "multiproc_parity_errors",
     "multiproc_double_served",
     "multiproc_dropped_acked",
+    "mixed_algo_parity_errors",
 )
 
 

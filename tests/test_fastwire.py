@@ -84,6 +84,23 @@ def test_parse_req_errors_and_special():
     assert special
 
 
+def test_parse_req_rejects_unknown_algorithm():
+    # Out-of-range algorithm values must not fall through the kernels'
+    # branchless dispatch as token-bucket (docs/algorithms.md); empty-
+    # key errors keep precedence, and all five valid values pass.
+    reqs = [
+        pb.RateLimitReq(name="n", unique_key="k", hits=1, algorithm=7),
+        pb.RateLimitReq(name="n", unique_key="", algorithm=9),
+    ] + [
+        pb.RateLimitReq(name="n", unique_key=f"ok{a}", hits=1, algorithm=a)
+        for a in range(5)
+    ]
+    cols, errors, special = _parity(reqs)
+    assert "invalid algorithm '7'" in errors[0]
+    assert errors[1] == "field 'unique_key' cannot be empty"
+    assert set(errors) == {0, 1}
+
+
 def test_parse_req_metadata_presence():
     r = pb.RateLimitReq(name="n", unique_key="k")
     r.metadata["trace"] = "abc"

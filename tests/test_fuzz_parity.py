@@ -34,7 +34,9 @@ BEHAVIOR_POOL = [
 
 def random_request(rng, keyspace):
     key = f"k{rng.integers(0, keyspace)}"
-    algorithm = Algorithm(int(rng.integers(0, 2)))
+    # All five algorithms, zoo included (docs/algorithms.md) — keys are
+    # shared across draws, so algorithm-switch restarts fuzz too.
+    algorithm = Algorithm(int(rng.integers(0, 5)))
     behavior = Behavior(0)
     if rng.random() < 0.25:
         behavior = BEHAVIOR_POOL[rng.integers(0, len(BEHAVIOR_POOL))]

@@ -261,6 +261,41 @@ def test_hard_kill_roundtrip_preserves_float_level_and_cold_tier(tmp_path):
         eng.close()
 
 
+def test_pre_zoo_snapshot_loads_with_zeroed_zoo_columns():
+    """Snapshots written before the algorithm zoo carry no tat/
+    prev_count columns; they must load with both zero-filled (fresh
+    TAT / empty previous window — docs/algorithms.md) while the legacy
+    charge survives, and live zoo state must round-trip the persistence
+    codec bit-exactly."""
+    now = 1_700_000_000_000
+    eng = TickEngine(capacity=128, max_batch=64)
+    eng.process([
+        RateLimitRequest(name="z", unique_key="g", hits=5, limit=10,
+                         duration=1_000, algorithm=3, created_at=now),
+        RateLimitRequest(name="z", unique_key="t", hits=7, limit=100,
+                         duration=3_600_000, created_at=now),
+    ], now=now)
+    snap = eng.export_columns()
+    assert (snap["tat"] != 0).any()           # live GCRA state exported
+    # The npz codec carries the zoo columns unchanged.
+    rt = decode_snapshot(encode_snapshot(snap))
+    np.testing.assert_array_equal(rt["tat"], snap["tat"])
+    np.testing.assert_array_equal(rt["prev_count"], snap["prev_count"])
+
+    legacy = {k: v for k, v in snap.items()
+              if k not in ("tat", "prev_count")}
+    fresh = TickEngine(capacity=128, max_batch=64)
+    fresh.load_columns(legacy, now=now)
+    snap2 = fresh.export_columns()
+    assert (snap2["tat"] == 0).all()
+    assert (snap2["prev_count"] == 0).all()
+    out = fresh.process([
+        RateLimitRequest(name="z", unique_key="t", hits=0, limit=100,
+                         duration=3_600_000, created_at=now),
+    ], now=now)
+    assert out[0].remaining == 93             # legacy charge survived
+
+
 # ----------------------------------------------------------------------
 # Service lifecycle
 # ----------------------------------------------------------------------

@@ -94,6 +94,78 @@ def test_put_supersedes_and_reopen_is_last_wins(tmp_path):
         s2.close()
 
 
+def test_pre_zoo_slabs_and_puts_zero_fill_zoo_columns(tmp_path):
+    """Slab records and put_columns batches written before the algorithm
+    zoo carry no tat/prev_count; both must read back zero-filled (fresh
+    TAT / empty previous window — docs/algorithms.md), not KeyError."""
+    import io
+
+    from gubernator_tpu.tiering.ssd import _decode_batch
+
+    # A pre-zoo slab payload: the npz encoding minus the zoo fields.
+    keys = mkeys(3)
+    blob = b"".join(keys)
+    offsets = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    enc = {"key_blob": np.frombuffer(blob, np.uint8),
+           "key_offsets": offsets}
+    for f in COLD_FIELDS:
+        if f in ("tat", "prev_count"):
+            continue
+        enc[f] = np.arange(3, dtype=np.float64 if f == "remaining_f"
+                           else np.int64)
+    buf = io.BytesIO()
+    np.savez(buf, **enc)
+    got_keys, cols = _decode_batch(buf.getvalue())
+    assert got_keys == keys
+    assert (cols["tat"] == 0).all()
+    assert (cols["prev_count"] == 0).all()
+    assert cols["remaining"].tolist() == [0, 1, 2]
+
+    # A pre-zoo demote batch (caller built its dict before the zoo):
+    # put_columns zero-fills the missing fields before staging.
+    s = ssd_store(tmp_path)
+    try:
+        legacy = {f: v for f, v in mkcols(2).items()
+                  if f not in ("tat", "prev_count")}
+        assert s.put_columns(mkeys(2, "pz"), legacy, NOW) == 2
+        s.flush()
+        pos, out = s.take_batch(mkeys(2, "pz"), NOW)
+        assert pos.tolist() == [0, 1]
+        assert (out["tat"] == 0).all()
+        assert (out["prev_count"] == 0).all()
+        assert out["remaining"].tolist() == [0, 1]
+    finally:
+        s.close()
+
+
+def test_zoo_state_survives_three_tier_roundtrip(tmp_path):
+    """A GCRA bucket demoted through cold→SSD and promoted back keeps
+    its theoretical arrival time: the rate smoothing survives tiering."""
+    e = TickEngine(capacity=2, max_batch=8, cold_capacity=2,
+                   ssd=ssd_store(tmp_path))
+    try:
+        # limit=10/1000ms -> T=100, tau=900: a full burst pins tat at
+        # NOW+1000, so the very next hit only conforms after one T.
+        r = e.process([req("g", hits=10, duration=1_000,
+                           algorithm=Algorithm.GCRA)], now=NOW)[0]
+        assert r.remaining == 0
+        # Push the bucket out of the device table and the cold tier.
+        for i in range(8):
+            e.process([req(f"fill{i}")], now=NOW)
+        e.ssd.flush()
+        assert len(e.ssd) > 0
+        # Promoted back: still OVER until NOW+100, conforms at NOW+100.
+        r = e.process([req("g", hits=1, duration=1_000,
+                           algorithm=Algorithm.GCRA)], now=NOW + 50)[0]
+        assert r.status == 1 and r.reset_time == NOW + 100
+        r = e.process([req("g", hits=1, duration=1_000,
+                           algorithm=Algorithm.GCRA)], now=NOW + 100)[0]
+        assert r.status == 0
+    finally:
+        e.close()
+
+
 def test_ttl_drop_on_read(tmp_path):
     s = ssd_store(tmp_path)
     try:

@@ -577,6 +577,13 @@ def test_repo_hot_path_markers_present():
         # batched lookup run on the dispatch thread — the file-syscall
         # arm of G001 keeps slab I/O on the background writer.
         "gubernator_tpu/tiering/ssd.py": ["put_columns", "take_batch"],
+        # Algorithm zoo (docs/algorithms.md): the N-way policy fold and
+        # each per-lane transition run inside every device tick — G001
+        # keeps them sync-free, G006 keeps them retrace-free.
+        "gubernator_tpu/algos/table.py": ["zoo_transitions"],
+        "gubernator_tpu/algos/sliding_window.py": ["transition"],
+        "gubernator_tpu/algos/gcra.py": ["transition"],
+        "gubernator_tpu/algos/concurrency.py": ["transition"],
     }
     for path, names in expected.items():
         text = proj.by_path[path].text

@@ -1,0 +1,363 @@
+"""Algorithm-zoo tests (docs/algorithms.md): golden semantics for the
+sliding-window, GCRA, and concurrency transitions through the real
+engine, seeded parity fuzz against the scalar references, the
+one-dispatch pin for mixed-policy batches, and the mesh's zero-retrace
+pin across changing algorithm mixes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from gubernator_tpu.algos import reference
+from gubernator_tpu.ops.engine import TickEngine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
+
+NOW = 1_700_000_000_000  # divisible by 1000: window-aligned golden math
+
+
+def req(key, alg, hits=1, limit=10, duration=1000, burst=0, behavior=0,
+        created_at=None):
+    return RateLimitRequest(
+        name="zoo", unique_key=key, hits=hits, limit=limit,
+        duration=duration, algorithm=alg, behavior=behavior, burst=burst,
+        created_at=created_at,
+    )
+
+
+# Module-scoped, and the same geometry other suite files compile (tier-1
+# runs near the driver budget — docs/algorithms.md tests must be
+# near-free): every test uses its own keys, so sharing the engine is safe.
+@pytest.fixture(scope="module")
+def eng():
+    return TickEngine(capacity=512, max_batch=64)
+
+
+def one(eng, r, now):
+    return eng.process([r], now=now)[0]
+
+
+# ----------------------------------------------------------------------
+# Golden semantics
+# ----------------------------------------------------------------------
+def test_sliding_window_weighted_carry(eng):
+    SW = Algorithm.SLIDING_WINDOW
+    # Fill the first window.
+    r = one(eng, req("sw", SW, hits=10, created_at=NOW), now=NOW)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+    assert r.reset_time == NOW + 1000  # current window's end
+    r = one(eng, req("sw", SW, hits=1, created_at=NOW), now=NOW)
+    assert r.status == Status.OVER_LIMIT
+    # One window later the old count carries at full weight...
+    t1 = NOW + 1000
+    r = one(eng, req("sw", SW, hits=1, created_at=t1), now=t1)
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    # ...and fades linearly: halfway through, 10*500//1000 = 5 weighted
+    # prior hits leave room for exactly 5 more.
+    t2 = NOW + 1500
+    r = one(eng, req("sw", SW, hits=5, created_at=t2), now=t2)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+    r = one(eng, req("sw", SW, hits=1, created_at=t2), now=t2)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_sliding_window_drain_and_negative_hits(eng):
+    SW = Algorithm.SLIDING_WINDOW
+    r = one(eng, req("swd", SW, hits=12, behavior=Behavior.DRAIN_OVER_LIMIT,
+                     created_at=NOW), now=NOW)
+    # Rejected, but the residual 10-hit budget burns (drain semantics).
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 0)
+    r = one(eng, req("swd", SW, hits=1, created_at=NOW), now=NOW)
+    assert r.status == Status.OVER_LIMIT
+    # Negative hits return budget, clamped at the window floor.
+    r = one(eng, req("swd", SW, hits=-3, created_at=NOW), now=NOW)
+    assert r.remaining == 3
+
+
+def test_gcra_burst_then_smooth_refill(eng):
+    G = Algorithm.GCRA
+    # limit=10/1000ms -> emission interval T=100ms, tau=900ms: a full
+    # burst conforms exactly once...
+    r = one(eng, req("g", G, hits=10, created_at=NOW), now=NOW)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 0)
+    r = one(eng, req("g", G, hits=1, created_at=NOW), now=NOW)
+    assert r.status == Status.OVER_LIMIT
+    # ...and reset_time is the exact earliest-conform instant: one T
+    # after the burst, precisely one slot has drained.
+    assert r.reset_time == NOW + 100
+    t1 = NOW + 100
+    r = one(eng, req("g", G, hits=1, created_at=t1), now=t1)
+    assert r.status == Status.UNDER_LIMIT
+    r = one(eng, req("g", G, hits=1, created_at=t1), now=t1)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_gcra_burst_one_disables_bursting(eng):
+    G = Algorithm.GCRA
+    # burst=1 -> tau=0: strictly one hit per emission interval.
+    r = one(eng, req("gb", G, hits=1, burst=1, created_at=NOW), now=NOW)
+    assert r.status == Status.UNDER_LIMIT
+    r = one(eng, req("gb", G, hits=1, burst=1, created_at=NOW), now=NOW)
+    assert r.status == Status.OVER_LIMIT
+    t1 = NOW + 100
+    r = one(eng, req("gb", G, hits=1, burst=1, created_at=t1), now=t1)
+    assert r.status == Status.UNDER_LIMIT
+
+
+def test_concurrency_acquire_release_clamp(eng):
+    C = Algorithm.CONCURRENCY
+    r = one(eng, req("c", C, hits=3, limit=5, created_at=NOW), now=NOW)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 2)
+    # All-or-nothing: 3 > 2 free slots rejects without partial acquire.
+    r = one(eng, req("c", C, hits=3, limit=5, created_at=NOW), now=NOW)
+    assert (r.status, r.remaining) == (Status.OVER_LIMIT, 2)
+    r = one(eng, req("c", C, hits=-1, limit=5, created_at=NOW), now=NOW)
+    assert r.remaining == 3
+    # Double-release clamps at limit — releases can't mint capacity.
+    r = one(eng, req("c", C, hits=-10, limit=5, created_at=NOW), now=NOW)
+    assert r.remaining == 5
+
+
+def test_concurrency_ttl_reclaims_leaked_slots(eng):
+    C = Algorithm.CONCURRENCY
+    r = one(eng, req("cl", C, hits=5, limit=5, duration=1000,
+                     created_at=NOW), now=NOW)
+    assert r.remaining == 0
+    # The holder dies without releasing; past the lease TTL the bucket
+    # expires and all five slots return.
+    t1 = NOW + 1001
+    r = one(eng, req("cl", C, hits=1, limit=5, duration=1000,
+                     created_at=t1), now=t1)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 4)
+
+
+def test_concurrency_limit_rebase_preserves_in_flight(eng):
+    C = Algorithm.CONCURRENCY
+    one(eng, req("cr", C, hits=2, limit=5, created_at=NOW), now=NOW)
+    # Raising the limit re-bases free slots by the delta: 2 stay
+    # in flight, 3+5 are free.
+    r = one(eng, req("cr", C, hits=0, limit=10, created_at=NOW), now=NOW)
+    assert r.remaining == 8
+
+
+def test_reset_remaining_restarts_zoo_bucket(eng):
+    G = Algorithm.GCRA
+    one(eng, req("rr", G, hits=10, created_at=NOW), now=NOW)
+    r = one(eng, req("rr", G, hits=1, behavior=Behavior.RESET_REMAINING,
+                     created_at=NOW), now=NOW)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 9)
+
+
+def test_algorithm_switch_restarts_bucket(eng):
+    one(eng, req("sw2", Algorithm.TOKEN_BUCKET, hits=5, created_at=NOW),
+        now=NOW)
+    # Same key, different algorithm: the stored-algorithm existence check
+    # fails and the bucket restarts as a fresh GCRA.
+    r = one(eng, req("sw2", Algorithm.GCRA, hits=1, created_at=NOW),
+            now=NOW)
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 9)
+
+
+# ----------------------------------------------------------------------
+# Parity fuzz vs the scalar references
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fuzz_engine_matches_references(seed):
+    """Randomized mixed traffic — all five algorithms, duplicates,
+    negative hits, queries, RESET/DRAIN, parameter churn, time
+    advancement — with every zoo-lane decision compared ``==`` against
+    the scalar references replaying the same stream."""
+    rng = np.random.default_rng(seed)
+    eng = TickEngine(capacity=512, max_batch=64)
+    now = NOW
+    model = {}
+
+    def ref_apply(r, t):
+        alg = int(r.algorithm)
+        if alg < int(Algorithm.SLIDING_WINDOW):
+            return None  # token/leaky parity is test_fuzz_parity's job
+        ns, resp = reference.transition(
+            model.get(r.unique_key),
+            dict(hits=r.hits, limit=r.limit, duration=r.duration,
+                 algorithm=alg, behavior=int(r.behavior), burst=r.burst,
+                 created_at=r.created_at),
+            t,
+        )
+        model[r.unique_key] = ns
+        return (resp["status"], resp["remaining"], resp["reset_time"])
+
+    for step in range(25):
+        now += int(rng.choice([0, 50, 400, 2_000, 61_000]))
+        reqs = []
+        for _ in range(48):
+            alg = int(rng.integers(0, 5))
+            behavior = 0
+            if rng.random() < 0.15:
+                behavior = int(rng.choice(
+                    [Behavior.RESET_REMAINING, Behavior.DRAIN_OVER_LIMIT]
+                ))
+            # Keys pinned per algorithm so the host model never needs
+            # token/leaky state (algorithm switches are covered above).
+            reqs.append(req(
+                f"k{int(rng.integers(0, 40))}-a{alg}", alg,
+                hits=int(rng.choice([0, 1, 1, 2, 5, -1, -3])),
+                limit=int(rng.choice([3, 10, 100])),
+                duration=int(rng.choice([1_000, 5_000, 60_000])),
+                burst=int(rng.choice([0, 2, 20])),
+                behavior=behavior, created_at=now,
+            ))
+        got = eng.process(reqs, now=now)
+        for r, g in zip(reqs, got):
+            want = ref_apply(r, now)
+            if want is None:
+                continue
+            assert (int(g.status), int(g.remaining),
+                    int(g.reset_time)) == want, (
+                f"seed {seed} step {step} key {r.unique_key} "
+                f"hits {r.hits} behavior {r.behavior}"
+            )
+
+
+# ----------------------------------------------------------------------
+# One dispatch for mixed-policy batches
+# ----------------------------------------------------------------------
+def test_mixed_five_algorithm_batch_is_one_dispatch(eng):
+    """A batch mixing all five algorithms — zoo duplicates included —
+    runs exactly ONE device tick program (docs/algorithms.md): the
+    per-lane algorithm fold replaces per-policy sub-batches."""
+    calls = []
+    saved = {n: getattr(eng, n) for n in ("_tick32", "_tick32m", "_tick")}
+    for name, fn in saved.items():
+        def wrap(fn, name=name):
+            def run(*a, **kw):
+                calls.append(name)
+                return fn(*a, **kw)
+            return run
+        setattr(eng, name, wrap(fn))
+
+    try:
+        # Unique mixed batch: one lane per algorithm.
+        reqs = [req(f"u{a}", a, created_at=NOW) for a in range(5)]
+        eng.process(reqs, now=NOW)
+        assert len(calls) == 1
+
+        # Mixed batch WITH zoo duplicates (fold-exempt — they ride size-1
+        # units of the same program, never a second dispatch).
+        calls.clear()
+        reqs = [req(f"d{a}", a, created_at=NOW)
+                for a in [0, 1, 2, 2, 3, 3, 4, 4, 4]]
+        eng.process(reqs, now=NOW)
+        assert len(calls) == 1
+    finally:
+        for name, fn in saved.items():  # the fixture outlives this test
+            setattr(eng, name, fn)
+    # Duplicate zoo lanes applied sequentially: 3 acquires landed.
+    r = one(eng, req("d4", Algorithm.CONCURRENCY, hits=0, created_at=NOW),
+            now=NOW)
+    assert r.remaining == 7
+
+
+# ----------------------------------------------------------------------
+# Mesh: parity + zero retraces across mixed-policy shapes
+# ----------------------------------------------------------------------
+def test_mesh_mixed_algos_parity_and_no_retrace():
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+
+    mesh_eng = MeshTickEngine(
+        mesh=make_mesh(jax.devices()), local_capacity=128, max_batch=64,
+    )
+    ref_eng = TickEngine(capacity=512, max_batch=64)
+    rng = np.random.default_rng(3)
+
+    def batch(algs):
+        return [
+            req(f"m{i % 24}-a{a}", a,
+                hits=int(rng.choice([0, 1, 2, -1])), created_at=None)
+            for i, a in enumerate(algs)
+        ]
+
+    # Warm every program variant with an all-five mix — a unique window
+    # (parts program) plus a duplicate-bearing one (merge walker), so
+    # the snapshot below covers both serving programs...
+    warm = batch([i % 5 for i in range(48)])
+    assert [
+        (r.status, r.remaining) for r in mesh_eng.process(warm, now=NOW)
+    ] == [
+        (r.status, r.remaining) for r in ref_eng.process(warm, now=NOW)
+    ]
+    dup = batch([i % 5 for i in range(24)] * 2)
+    assert [
+        (r.status, r.remaining) for r in mesh_eng.process(dup, now=NOW)
+    ] == [
+        (r.status, r.remaining) for r in ref_eng.process(dup, now=NOW)
+    ]
+    traces = dict(mesh_eng.ops.trace_counts)
+
+    # ...then vary the algorithm mix per window: decisions stay
+    # bit-identical to the single-chip replay and nothing retraces
+    # (the mix is data, not program shape).
+    mixes = [[2] * 48, [0, 3] * 24, [4] * 48, [1, 2, 3, 4] * 12,
+             [int(a) for a in rng.integers(0, 5, 48)]]
+    for i, algs in enumerate(mixes):
+        b = batch(algs)
+        now = NOW + 1 + i
+        got = mesh_eng.process(b, now=now)
+        want = ref_eng.process(b, now=now)
+        assert [(r.status, r.remaining, r.reset_time) for r in got] == \
+               [(r.status, r.remaining, r.reset_time) for r in want]
+    assert dict(mesh_eng.ops.trace_counts) == traces
+
+
+# ----------------------------------------------------------------------
+# Edge validation: out-of-range algorithm is a per-item error
+# ----------------------------------------------------------------------
+def test_columns_from_pb_rejects_unknown_algorithm():
+    from gubernator_tpu.pb import gubernator_pb2 as pb
+    from gubernator_tpu.transport.convert import columns_from_pb
+
+    ms = [
+        pb.RateLimitReq(name="a", unique_key="k", hits=1, algorithm=7),
+        pb.RateLimitReq(name="a", unique_key="k2", hits=1,
+                        algorithm=int(Algorithm.CONCURRENCY)),
+        # Empty-key errors keep precedence over the algorithm check.
+        pb.RateLimitReq(name="a", unique_key="", algorithm=9),
+    ]
+    cols, errors, special = columns_from_pb(ms)
+    assert "invalid algorithm '7'" in errors[0]
+    assert 1 not in errors
+    assert errors[2] == "field 'unique_key' cannot be empty"
+
+
+def test_instance_rejects_unknown_algorithm_per_item():
+    """The object path answers an out-of-range algorithm with an
+    error-in-item (the reference's convention) and still serves the
+    rest of the batch; accepted items feed the per-algorithm counter."""
+    import asyncio
+
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+
+    async def run():
+        inst = await V1Instance.create(
+            InstanceConfig(cache_size=256, tpu_max_batch=64)
+        )
+        try:
+            reqs = [
+                req("ok", Algorithm.GCRA, created_at=NOW),
+                req("bad", 7, created_at=NOW),
+                req("ok2", Algorithm.SLIDING_WINDOW, created_at=NOW),
+            ]
+            out = await inst.get_rate_limits(reqs)
+            assert out[0].status == Status.UNDER_LIMIT and not out[0].error
+            assert "invalid algorithm '7'" in out[1].error
+            assert out[2].status == Status.UNDER_LIMIT and not out[2].error
+            m = inst.metrics
+            assert m.sample("gubernator_tpu_algorithm_requests_total",
+                            {"algorithm": "gcra"}) == 1.0
+            assert m.sample("gubernator_tpu_algorithm_requests_total",
+                            {"algorithm": "sliding_window"}) == 1.0
+            assert m.sample("gubernator_check_error_counter_total",
+                            {"error": "Invalid request"}) == 1.0
+        finally:
+            await inst.close()
+
+    asyncio.run(run())
