@@ -1968,6 +1968,162 @@ def rung_chaos():
 
 
 # ----------------------------------------------------------------------
+# Federation rung: two regions, WAN partition, bounded over-admission
+# and exactly-zero hit loss after the heal (docs/federation.md)
+# ----------------------------------------------------------------------
+async def _federation_bench():
+    """Two-region federated cluster under a full WAN partition.  Both
+    regions keep serving from local state; drift is bounded by
+    staleness × local rate.  Two keys measure the two halves of the
+    guarantee:
+
+    * an unconstrained key counts every hit taken on both sides during
+      the partition — after the heal both regions must converge on the
+      exact union (``federation_hit_loss_after_heal``, gated at 0
+      absolutely: over-admission overshoots, loss undershoots);
+    * a small-limit key is driven to OVER_LIMIT on both sides — the
+      combined admissions beyond one limit's worth are the partition's
+      over-admission (``federation_over_admission_ratio`` = extra/limit,
+      structurally <= 1.0 for a 2-region split; gated at 1.0)."""
+    from gubernator_tpu.cluster import Cluster
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.resilience import FaultInjector, ResilienceConfig
+    from gubernator_tpu.types import Behavior, RateLimitRequest, Status
+
+    behaviors = BehaviorConfig(global_sync_wait=0.02, batch_wait=0.001)
+    resilience = ResilienceConfig(
+        breaker_open_for=0.05, breaker_open_cap=0.1, breaker_min_requests=3,
+        forward_backoff_base=0.002, forward_backoff_cap=0.02,
+    )
+    inj = FaultInjector(seed=11)
+    c = await Cluster.start(
+        4, datacenters=["us", "us", "eu", "eu"], behaviors=behaviors,
+        resilience=resilience, fault_injector=inj, federation=True,
+        federation_interval=0.02,
+    )
+    try:
+        name = "fedbench"
+        small_limit = 24 if FAST else 60
+
+        def mr(key, hits, limit):
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=limit,
+                duration=3_600_000, behavior=Behavior.MULTI_REGION,
+            )
+
+        owners = {
+            r: {
+                "loss": c.find_owning_daemon_in_region(name, "loss", r),
+                "over": c.find_owning_daemon_in_region(name, "over", r),
+            }
+            for r in ("us", "eu")
+        }
+
+        # Healthy warm-up: one hit each side compiles the programs and
+        # proves the exchange is live before the partition starts.
+        for r in ("us", "eu"):
+            cl = owners[r]["loss"].client()
+            out = await cl.get_rate_limits(
+                [mr("loss", 1, 1_000_000)], timeout=30.0)
+            if out[0].error:
+                raise RuntimeError(f"warm-up errored: {out[0].error}")
+            await cl.close()
+
+        # WAN partition: directional schedules cut every cross-region
+        # link; intra-region links stay up.
+        for da in c.daemons:
+            for db in c.daemons:
+                if da.conf.data_center == "us" and db.conf.data_center == "eu":
+                    inj.set_fault(db.conf.grpc_listen_address,
+                                  from_peer=da.advertise_address,
+                                  partition=True)
+                    inj.set_fault(da.conf.grpc_listen_address,
+                                  from_peer=db.advertise_address,
+                                  partition=True)
+
+        n_loss = {"us": 20 if FAST else 120, "eu": 15 if FAST else 90}
+        sent = 2  # warm-up hits
+        t0 = time.perf_counter()
+        for r in ("us", "eu"):
+            cl = owners[r]["loss"].client()
+            for _ in range(n_loss[r]):
+                out = await cl.get_rate_limits(
+                    [mr("loss", 1, 1_000_000)], timeout=30.0)
+                if out[0].error:
+                    raise RuntimeError(f"degraded answer errored: "
+                                       f"{out[0].error}")
+                sent += 1
+            await cl.close()
+        degraded_dt = time.perf_counter() - t0
+
+        # Over-admission key: each isolated region admits up to one full
+        # limit; drive both sides to OVER_LIMIT and count admissions.
+        admitted = 0
+        for r in ("us", "eu"):
+            cl = owners[r]["over"].client()
+            for _ in range(2 * small_limit):
+                out = await cl.get_rate_limits(
+                    [mr("over", 1, small_limit)], timeout=30.0)
+                if out[0].error:
+                    raise RuntimeError(f"over key errored: {out[0].error}")
+                if out[0].status == Status.OVER_LIMIT:
+                    break
+                admitted += 1
+            await cl.close()
+        over_ratio = max(0, admitted - small_limit) / small_limit
+
+        # Heal: buffered envelopes replay, the receive ledger dedupes,
+        # and both regions converge on the exact union of loss-key hits.
+        inj.clear()
+        landed = {}
+        for r in ("us", "eu"):
+            cl = owners[r]["loss"].client()
+            landed[r] = 0
+            deadline = time.perf_counter() + 20
+            while time.perf_counter() < deadline:
+                resp = (await cl.get_rate_limits(
+                    [mr("loss", 0, 1_000_000)], timeout=30.0))[0]
+                landed[r] = 1_000_000 - resp.remaining
+                if landed[r] == sent:
+                    break
+                await asyncio.sleep(0.02)
+            await cl.close()
+        loss = abs(sent - landed["us"]) + abs(sent - landed["eu"])
+
+        def total(metric, labels=None):
+            return sum(
+                d.metrics.sample(metric, labels) or 0 for d in c.daemons)
+
+        return {
+            "rung": "federation_2r",
+            "requests_per_sec": round(
+                (n_loss["us"] + n_loss["eu"]) / degraded_dt, 1),
+            "hits_sent": sent,
+            "hits_landed_us": int(landed["us"]),
+            "hits_landed_eu": int(landed["eu"]),
+            # The two gated headline numbers (check_bench_regression.py).
+            "federation_hit_loss_after_heal": int(loss),
+            "federation_over_admission_ratio": round(over_ratio, 4),
+            "over_admitted": int(admitted),
+            "over_limit": small_limit,
+            "envelopes_sent": total(
+                "gubernator_tpu_federation_envelopes_total",
+                {"result": "sent"}),
+            "envelopes_applied": total(
+                "gubernator_tpu_federation_envelopes_total",
+                {"result": "applied"}),
+            "redeliveries": total(
+                "gubernator_tpu_federation_redeliveries_total"),
+        }
+    finally:
+        await c.stop()
+
+
+def rung_federation():
+    return asyncio.run(_federation_bench())
+
+
+# ----------------------------------------------------------------------
 # Restart-recovery rung: traffic -> SIGTERM -> restart -> verify, plus a
 # ring-swap ownership handoff — both losses gated at exactly 0
 # ----------------------------------------------------------------------
@@ -3308,6 +3464,7 @@ def main():
     # bucket accounting stays exact (docs/leases.md).
     ladder.append(_safe("engine_leases", rung_engine_leases))
     ladder.append(_safe("chaos_redelivery", rung_chaos))
+    ladder.append(_safe("federation_2r", rung_federation))
     ladder.append(_safe("restart_recovery", rung_restart_recovery))
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("mesh_zipf_8", rung_mesh_zipf))

@@ -28,6 +28,8 @@ def _daemon_config(
     cache_size: int = 4096,
     resilience=None,
     fault_injector=None,
+    federation: bool = False,
+    federation_interval: float = 0.05,
 ) -> DaemonConfig:
     conf = DaemonConfig(
         grpc_listen_address="127.0.0.1:0",
@@ -39,6 +41,8 @@ def _daemon_config(
         behaviors=behaviors or BehaviorConfig(),
         cache_size=cache_size,
         data_center=datacenter,
+        federation_enabled=federation,
+        federation_interval=federation_interval,
     )
     if resilience is not None:
         conf.config.resilience = resilience
@@ -65,6 +69,8 @@ class Cluster:
         global_mesh: bool = False,
         resilience=None,
         fault_injector=None,
+        federation: bool = False,
+        federation_interval: float = 0.05,
     ) -> "Cluster":
         """Boot ``n`` daemons (dc layout via ``datacenters``, one entry per
         daemon) and wire them into one cluster (cluster.go:123-189).
@@ -76,6 +82,10 @@ class Cluster:
         ``resilience``/``fault_injector`` thread the fault-tolerant peer
         path's knobs and the chaos hook into every daemon (the injector is
         shared, so one schedule partitions a peer cluster-wide).
+
+        ``federation=True`` enables the inter-region envelope exchange
+        (docs/federation.md) on daemons with a datacenter, at the fast
+        test cadence ``federation_interval``.
         """
         c = cls()
         datacenters = list(datacenters or [""] * n)
@@ -95,7 +105,9 @@ class Cluster:
             )
         for idx, dc in enumerate(datacenters):
             conf = _daemon_config(dc, behaviors, cache_size,
-                                  resilience, fault_injector)
+                                  resilience, fault_injector,
+                                  federation and bool(dc),
+                                  federation_interval)
             if http_gateway:
                 conf.http_listen_address = "127.0.0.1:0"
             d = Daemon(conf, global_mesh=mesh_engine, global_mesh_node=idx)
@@ -133,6 +145,21 @@ class Cluster:
                 return d
         raise RuntimeError(f"no daemon listening on {addr}")
 
+    def find_owning_daemon_in_region(
+        self, name: str, key: str, datacenter: str
+    ) -> Daemon:
+        """The daemon owning ``name_key`` on ``datacenter``'s own ring.
+        Resolution must go through a daemon IN that region — each local
+        picker only contains its own datacenter's members."""
+        d0 = self.get_random_peer(datacenter)
+        owner = d0.instance.get_peer(name + "_" + key)
+        addr = d0.conf.grpc_listen_address if owner is None \
+            else owner.info.grpc_address
+        for d in self.daemons:
+            if d.conf.grpc_listen_address == addr:
+                return d
+        raise RuntimeError(f"no daemon listening on {addr}")
+
     def list_non_owning_daemons(self, name: str, key: str) -> List[Daemon]:
         owner = self.find_owning_daemon(name, key)
         return [d for d in self.daemons if d is not owner]
@@ -157,6 +184,8 @@ class Cluster:
             old.conf.config.cache_size,
             old.conf.config.resilience,
             old.conf.config.fault_injector,
+            old.conf.config.federation_enabled,
+            old.conf.config.federation_interval,
         )
         conf.grpc_listen_address = addr
         d = Daemon(conf)

@@ -76,6 +76,10 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_FAULT_PARTITION": "fault injection: 100% UNAVAILABLE",
     "GUBER_FAULT_PEERS": "fault injection: target peers or '*'",
     "GUBER_FAULT_SEED": "fault injection: RNG seed",
+    "GUBER_FEDERATION_BATCH_LIMIT": "max envelope records per federation flush",
+    "GUBER_FEDERATION_ENABLED": "multi-region federation exchange on/off",
+    "GUBER_FEDERATION_INTERVAL": "inter-region envelope exchange cadence",
+    "GUBER_FEDERATION_TIMEOUT": "deadline for federation envelope RPCs",
     "GUBER_FLIGHT_RECORDER_WINDOWS": "flight-recorder ring size (window records)",
     "GUBER_FORCE_GLOBAL": "force GLOBAL behavior on every request",
     "GUBER_FORWARD_BACKOFF_BASE": "forward-retry backoff base",
@@ -318,6 +322,18 @@ class Config:
     edge_workers: int = 0
     edge_shm_slabs: int = 8
     edge_ring_depth: int = 16
+
+    # Multi-region GLOBAL federation (docs/federation.md): when enabled,
+    # owner-side GLOBAL state changes additionally fan out as bounded-
+    # staleness envelopes to the owning peer in every *other* datacenter
+    # (region_picker), batched per federation_interval and shipped over
+    # the resilience breaker/backoff/redelivery path.  Requires
+    # data_center to be set — regions are keyed by it.
+    # GUBER_FEDERATION_* / GUBER_DATA_CENTER.
+    federation_enabled: bool = False
+    federation_interval: float = 1.0
+    federation_batch_limit: int = 1000
+    federation_timeout: float = 1.0
 
     # Fault-tolerant peer path (docs/resilience.md): per-peer circuit
     # breakers, forward-retry backoff, and the GLOBAL redelivery buffer.
@@ -628,6 +644,10 @@ def setup_daemon_config(
         edge_shm_slabs=r.int_("GUBER_EDGE_SHM_SLABS", 8),
         edge_ring_depth=r.int_("GUBER_EDGE_RING_DEPTH", 16),
         data_center=r.str_("GUBER_DATA_CENTER"),
+        federation_enabled=r.bool_("GUBER_FEDERATION_ENABLED"),
+        federation_interval=r.float_seconds("GUBER_FEDERATION_INTERVAL", 1.0),
+        federation_batch_limit=r.int_("GUBER_FEDERATION_BATCH_LIMIT", 1000),
+        federation_timeout=r.float_seconds("GUBER_FEDERATION_TIMEOUT", 1.0),
         local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
         instance_id=r.str_("GUBER_INSTANCE_ID"),
@@ -723,6 +743,26 @@ def setup_daemon_config(
     if conf.edge_ring_depth < 1:
         raise ValueError(
             f"GUBER_EDGE_RING_DEPTH must be >= 1; got {conf.edge_ring_depth}"
+        )
+    if conf.federation_interval <= 0:
+        raise ValueError(
+            f"GUBER_FEDERATION_INTERVAL must be > 0; "
+            f"got {conf.federation_interval}"
+        )
+    if conf.federation_batch_limit < 1:
+        raise ValueError(
+            f"GUBER_FEDERATION_BATCH_LIMIT must be >= 1; "
+            f"got {conf.federation_batch_limit}"
+        )
+    if conf.federation_timeout <= 0:
+        raise ValueError(
+            f"GUBER_FEDERATION_TIMEOUT must be > 0; "
+            f"got {conf.federation_timeout}"
+        )
+    if conf.federation_enabled and not conf.data_center:
+        raise ValueError(
+            "GUBER_FEDERATION_ENABLED requires GUBER_DATA_CENTER: regions "
+            "are keyed by datacenter name and this node must know its own"
         )
     if not 0.0 < resilience.breaker_failure_threshold <= 1.0:
         raise ValueError(

@@ -1,0 +1,369 @@
+"""Inter-region bounded-staleness reconcile: the federation sender/receiver.
+
+Two-level GLOBAL topology (docs/federation.md): intra-region stays the
+existing GlobalManager psum-native reconcile untouched; this manager is
+the *inter*-region half.  The intra-region owner of a GLOBAL key — the
+one node in its region that sees every hit for it (non-owners forward
+theirs through the hits loop) — feeds each owner-side state change here
+(:meth:`queue`); deltas accumulate per remote region and per key, and a
+supervised loop flushes them every ``GUBER_FEDERATION_INTERVAL`` as
+:class:`~gubernator_tpu.federation.envelope.FederationEnvelope` frames
+to the owning peer of each key in the remote region's own ring
+(RegionPicker — the sender computes remote ownership locally because
+every region runs the same hash).
+
+No client request ever waits on a cross-region RPC: requests are
+answered from region-local state (the PR 3 degraded-answer discipline
+absorbs WAN latency/partitions), so region isolation degrades to
+bounded local over-admission — at most ``federation_interval ×
+local_rate`` hits drift per region — and never to an outage.
+
+Delivery rides the PR 3 machinery: the target peer's circuit breaker
+(one owning peer per region per flush, so the per-region breaker IS
+that peer's breaker), decorrelated-jitter backoff between retries, and
+a merge-on-requeue pending buffer bounded by ``GUBER_REDELIVERY_LIMIT``
+distinct keys.  Exactly-once comes from the channel discipline: at most
+one envelope is in flight per (this node → target peer) channel, a
+failed send retries the *same* envelope (same seq, same records), and
+new deltas merge into pending for the next seq — paired with the
+receiver's :class:`~gubernator_tpu.federation.envelope.ReceiveLedger`
+duplicate gate, a partition heals by replaying the buffer with zero
+hit loss and zero double-counts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from gubernator_tpu.federation.envelope import (
+    FederationAck,
+    FederationEnvelope,
+    FederationRecord,
+    ReceiveLedger,
+    merge_records,
+)
+from gubernator_tpu.resilience import (
+    DecorrelatedJitterBackoff,
+    spawn_supervised,
+)
+from gubernator_tpu.types import Behavior, RateLimitRequest, set_behavior
+
+log = logging.getLogger("gubernator.federation")
+
+# Metadata key stamped on federation-applied requests: the receive path
+# submits them through the normal owner handler (which re-broadcasts
+# intra-region), and GlobalManager's federation feed skips requests
+# carrying it — without the tag, region A's hits applied in B would
+# federate back to A (and to every third region the origin already
+# reached directly), double-counting on each lap.
+FED_ORIGIN_KEY = "fed-origin"
+
+
+@dataclass
+class _Channel:
+    """One (this node → remote owning peer) envelope stream."""
+
+    peer: object
+    region: str
+    seq: int = 0                    # last assigned sequence
+    inflight: Optional[FederationEnvelope] = None
+    inflight_since: float = 0.0
+    failing: bool = False           # last send attempt failed
+    next_try: float = 0.0
+    backoff: DecorrelatedJitterBackoff = field(
+        default=None)  # type: ignore[assignment]
+
+
+class FederationManager:
+    """Owns the inter-region exchange for one V1Instance."""
+
+    def __init__(self, instance, metrics=None, clock=time.monotonic,
+                 sleep=asyncio.sleep):
+        self.instance = instance
+        self.metrics = metrics
+        self._clock = clock
+        self._sleep = sleep
+        conf = instance.conf
+        self.home = conf.data_center
+        self.interval = conf.federation_interval
+        self.batch_limit = conf.federation_batch_limit
+        self.timeout = conf.federation_timeout
+        self.resilience = conf.resilience
+        # region → key → accumulated delta (merge-on-requeue buffer).
+        self._pending: Dict[str, Dict[str, FederationRecord]] = {}
+        # region → enqueue time of the oldest un-flushed delta.
+        self._pending_since: Dict[str, float] = {}
+        # target grpc address → channel.
+        self._channels: Dict[str, _Channel] = {}
+        self.ledger = ReceiveLedger()
+        # One apply at a time per origin channel: a redelivery racing a
+        # still-running slow apply of the same envelope must wait and
+        # then read the marked ledger (duplicate), not start a second
+        # apply off the not-yet-marked one.
+        self._apply_locks: Dict[str, asyncio.Lock] = {}
+        self._running = True
+        self._task = spawn_supervised(
+            self._flush_loop, name="federation-flush",
+            should_restart=lambda: self._running,
+            metrics=metrics, loop_label="federation_flush",
+        )
+
+    @property
+    def origin(self) -> str:
+        """This node's channel identity: its advertise address."""
+        return self.instance.conf.advertise_address
+
+    # ------------------------------------------------------------------
+    # Sender side
+    # ------------------------------------------------------------------
+    def queue(self, req: RateLimitRequest) -> None:
+        """Record one owner-side GLOBAL state change for every remote
+        region.  Called from GlobalManager.queue_update — the one place
+        every hit in this region funnels through exactly once."""
+        if req.hits == 0:
+            return
+        if req.metadata.get(FED_ORIGIN_KEY):
+            return  # applied FROM a peer region; never re-federate
+        try:
+            regions = self.instance.region_picker.regions()
+        except Exception:
+            return
+        limit = self.resilience.redelivery_limit
+        now = self._clock()
+        dropped_total = 0
+        for region in regions:
+            if not region or region == self.home:
+                continue
+            pending = self._pending.setdefault(region, {})
+            if not pending:
+                self._pending_since[region] = now
+            rec = FederationRecord(
+                name=req.name, unique_key=req.unique_key, hits=req.hits,
+                limit=req.limit, duration=req.duration,
+                algorithm=int(req.algorithm), behavior=int(req.behavior),
+                burst=req.burst, created_at=req.created_at or 0,
+            )
+            _, dropped = merge_records(pending, [rec], limit)
+            dropped_total += dropped
+        if dropped_total:
+            # Never silent: a full pending buffer under a long partition
+            # means this key's drift will NOT heal on rejoin.
+            log.warning(
+                "federation pending buffer full (GUBER_REDELIVERY_LIMIT"
+                "=%d keys): dropped %d new-key records", limit,
+                dropped_total,
+            )
+
+    async def _flush_loop(self) -> None:
+        while self._running:
+            await self._sleep(self.interval)
+            if not self._running:
+                return
+            await self._flush_once()
+            self._update_staleness()
+
+    async def _flush_once(self, force_retry: bool = False) -> None:
+        """Compact pending deltas into envelopes on idle channels, then
+        send every due envelope concurrently."""
+        for region, pending in self._pending.items():
+            if not pending:
+                continue
+            self._compact(region, pending)
+            if not pending:
+                self._pending_since.pop(region, None)
+        now = self._clock()
+        due = [
+            ch for ch in self._channels.values()
+            if ch.inflight is not None and (force_retry or now >= ch.next_try)
+        ]
+        if due:
+            await asyncio.gather(*(self._send(ch) for ch in due))
+
+    def _compact(self, region: str,
+                 pending: Dict[str, FederationRecord]) -> None:
+        """Route pending keys to their remote-region owners and build the
+        next envelope on every channel without one in flight.  Keys whose
+        channel is busy (or whose region has no reachable ring yet) stay
+        pending — merge-on-requeue keeps accumulating their hits."""
+        groups: Dict[str, tuple] = {}
+        for key in pending:
+            try:
+                peer = self.instance.region_picker.get(key, region)
+            except Exception:
+                return  # no ring for the region yet; keep everything
+            addr = peer.info.grpc_address
+            if addr in groups:
+                groups[addr][1].append(key)
+            else:
+                groups[addr] = (peer, [key])
+        for addr, (peer, keys) in groups.items():
+            ch = self._channels.get(addr)
+            if ch is None:
+                rc = self.resilience
+                ch = self._channels[addr] = _Channel(
+                    peer=peer, region=region,
+                    backoff=DecorrelatedJitterBackoff(
+                        rc.forward_backoff_base, rc.forward_backoff_cap),
+                )
+            ch.peer = peer  # ring churn may swap the handle
+            if ch.inflight is not None:
+                continue
+            take = keys[: self.batch_limit]
+            ch.seq += 1
+            ch.inflight = FederationEnvelope(
+                origin=self.origin, region=self.home, seq=ch.seq,
+                records=[pending.pop(k) for k in take],
+            )
+            ch.inflight_since = self._clock()
+
+    async def _send(self, ch: _Channel) -> None:
+        env = ch.inflight
+        try:
+            ack = await ch.peer.federation_sync(env, timeout=self.timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # BreakerOpenError / AioRpcError / malformed-frame — all the
+            # same to the channel: the envelope stays in flight and
+            # retries with the SAME seq after a jittered backoff.  The
+            # receiver's ledger makes the retry safe even when only the
+            # ack was lost.
+            ch.failing = True
+            ch.next_try = self._clock() + ch.backoff.next()
+            if self.metrics is not None:
+                self.metrics.federation_redeliveries.inc()
+            return
+        if ack.seq >= env.seq:
+            ch.inflight = None
+            ch.inflight_since = 0.0
+            ch.failing = False
+            ch.next_try = 0.0
+            ch.backoff.reset()
+            if self.metrics is not None:
+                self.metrics.federation_envelopes.labels(result="sent").inc()
+
+    def _update_staleness(self) -> None:
+        """Export the worst-case cross-region drift age: the oldest delta
+        not yet acked by its target region (pending or in flight)."""
+        if self.metrics is None:
+            return
+        now = self._clock()
+        oldest = None
+        for ts in self._pending_since.values():
+            oldest = ts if oldest is None else min(oldest, ts)
+        for ch in self._channels.values():
+            if ch.inflight is not None and ch.inflight_since:
+                ts = ch.inflight_since
+                oldest = ts if oldest is None else min(oldest, ts)
+        self.metrics.federation_staleness.set(
+            max(0.0, now - oldest) if oldest is not None else 0.0)
+
+    def is_degraded(self) -> bool:
+        """True while any remote region is unreachable (its channel's
+        breaker is open or its last send failed): MULTI_REGION answers
+        served now may over-admit up to the staleness budget."""
+        for ch in self._channels.values():
+            if ch.failing:
+                return True
+            breaker = getattr(ch.peer, "breaker", None)
+            if breaker is not None and breaker.is_open():
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Receiver side
+    # ------------------------------------------------------------------
+    async def receive(self, env: FederationEnvelope) -> FederationAck:
+        """Apply one envelope from a peer region and ack it.
+
+        Duplicates (a redelivery whose ack was lost) are acked without
+        re-applying; a failed apply leaves the ledger unmarked so the
+        sender's retry of the same seq lands the records.
+
+        Cancellation-shielded: the sender's RPC deadline cancels the
+        transport handler, but an apply that already committed hits to
+        the engine MUST still mark the ledger — cancelling between the
+        two would turn every slow apply (e.g. a first-use JIT compile)
+        into a double-count when the same envelope is redelivered."""
+        return await asyncio.shield(self._receive_inner(env))
+
+    async def _receive_inner(self, env: FederationEnvelope) -> FederationAck:
+        lock = self._apply_locks.setdefault(env.origin, asyncio.Lock())
+        async with lock:
+            return await self._apply_locked(env)
+
+    async def _apply_locked(self, env: FederationEnvelope) -> FederationAck:
+        if self.ledger.seen(env):
+            if self.metrics is not None:
+                self.metrics.federation_envelopes.labels(
+                    result="duplicate").inc()
+            return FederationAck(origin=env.origin, seq=env.seq, applied=0)
+        reqs: List[RateLimitRequest] = []
+        for rec in env.records:
+            reqs.append(RateLimitRequest(
+                name=rec.name,
+                unique_key=rec.unique_key,
+                hits=rec.hits,
+                limit=rec.limit,
+                duration=rec.duration,
+                algorithm=rec.algorithm,
+                behavior=set_behavior(rec.behavior, Behavior.GLOBAL, True),
+                burst=rec.burst,
+                metadata={FED_ORIGIN_KEY: env.region},
+                created_at=rec.created_at or None,
+            ))
+        if reqs:
+            # The owner-relay handler: forces DRAIN_OVER_LIMIT on GLOBAL
+            # hits, applies to the local engine, and queues the intra-
+            # region broadcast — the remote region's hits reach every
+            # local peer through the existing machinery.
+            await self.instance.get_peer_rate_limits(reqs)
+        self.ledger.mark(env)
+        if self.metrics is not None:
+            self.metrics.federation_envelopes.labels(result="applied").inc()
+        return FederationAck(
+            origin=env.origin, seq=env.seq, applied=len(reqs))
+
+    # ------------------------------------------------------------------
+    # Introspection / shutdown
+    # ------------------------------------------------------------------
+    def pending_keys(self) -> int:
+        return sum(len(p) for p in self._pending.values())
+
+    def inflight_envelopes(self) -> int:
+        return sum(
+            1 for ch in self._channels.values() if ch.inflight is not None)
+
+    async def _final_flush(self) -> None:
+        """Bounded drain rounds through the normal flush path, retrying
+        immediately (no backoff waits — the caller's deadline is the
+        budget)."""
+        for _ in range(4):
+            if not (self.pending_keys() or self.inflight_envelopes()):
+                return
+            await self._flush_once(force_retry=True)
+
+    async def close(self, drain_timeout: float = 0.0) -> None:
+        """Stop the flush loop, then (graceful-drain path) push what's
+        still buffered under a bounded deadline."""
+        self._running = False
+        self._task.cancel()
+        await asyncio.gather(self._task, return_exceptions=True)
+        if drain_timeout > 0 and (
+                self.pending_keys() or self.inflight_envelopes()):
+            try:
+                await asyncio.wait_for(self._final_flush(), drain_timeout)
+            except asyncio.TimeoutError:
+                log.warning(
+                    "federation drain deadline (%.1fs) expired with %d "
+                    "pending keys / %d in-flight envelopes",
+                    drain_timeout, self.pending_keys(),
+                    self.inflight_envelopes(),
+                )
+            except Exception:
+                log.exception("federation drain flush failed")
+        self._update_staleness()

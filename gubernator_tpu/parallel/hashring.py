@@ -158,6 +158,11 @@ class RegionPicker(Generic[P]):
     def pickers(self) -> Dict[str, ReplicatedConsistentHash[P]]:
         return dict(self._regions)
 
+    def regions(self) -> List[str]:
+        """Known datacenter names, sorted (deterministic fan-out order
+        for the federation exchange)."""
+        return sorted(self._regions)
+
     def add(self, peer: P) -> None:
         info = getattr(peer, "info", peer)
         if callable(info):

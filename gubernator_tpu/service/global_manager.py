@@ -56,6 +56,10 @@ class GlobalManager:
         self.resilience = resilience or ResilienceConfig()
         self._hits: Dict[str, RateLimitRequest] = {}
         self._updates: Dict[str, RateLimitRequest] = {}
+        # Inter-region federation feed (docs/federation.md): installed by
+        # V1Instance when GUBER_FEDERATION_ENABLED; every owner-side
+        # update queued here also feeds the per-region envelope buffers.
+        self.federation = None
         # GLOBAL keys this node has answered as owner, key → prototype
         # request (algorithm/limit/duration — what a state re-read
         # needs).  The ownership-handoff working set: after a ring swap,
@@ -98,7 +102,14 @@ class GlobalManager:
                 )
             prev.hits += req.hits
         else:
-            self._hits[req.hash_key()] = RateLimitRequest(**vars(req))
+            clone = RateLimitRequest(**vars(req))
+            # The caller was already answered locally — no one is waiting
+            # on this flush.  A propagated admission budget must not ride
+            # the queued copy: an owner outage longer than the budget
+            # would otherwise make every redelivery raise BudgetExhausted
+            # before the RPC, and the buffered hits could never land.
+            clone.deadline = None
+            self._hits[req.hash_key()] = clone
         if self.metrics is not None:
             self.metrics.global_send_queue_length.set(len(self._hits))
         self._hits_kick.set()
@@ -107,6 +118,12 @@ class GlobalManager:
         """Record an owner-side state change for broadcast (global.go:80-84)."""
         if req.hits == 0:
             return
+        if self.federation is not None:
+            # This is the one funnel every owner-side GLOBAL hit in the
+            # region passes exactly once — the right tap for the
+            # inter-region delta stream (queue() itself skips requests
+            # applied FROM a peer region).
+            self.federation.queue(req)
         key = req.hash_key()
         self._updates[key] = req
         if key in self._owned or len(self._owned) < self.resilience.redelivery_limit:
@@ -369,7 +386,16 @@ class GlobalManager:
         broadcast's authoritative-read pattern) and install it on the new
         owner via ``UpdatePeerGlobals`` — the key keeps counting from its
         accumulated level instead of resetting (the process-scope twin of
-        the tiering fresh-bucket fix).  A failed push re-enqueues the
+        the tiering fresh-bucket fix).
+
+        Region scoping (docs/federation.md): candidate owners resolve
+        through ``get_peer`` — the *local* picker, which ``set_peers``
+        builds only from this datacenter's members — never the
+        RegionPicker.  GLOBAL state must not be pushed cross-datacenter
+        here: remote regions converge through the federation envelope
+        stream (bounded staleness, loop-tagged), and a raw
+        UpdatePeerGlobals install over the WAN would bypass that
+        discipline and double-apply on the next envelope.  A failed push re-enqueues the
         source update into the bounded broadcast redelivery buffer, whose
         next flush re-reads state and pushes to every peer — a slow new
         owner delays the transfer, never loses it.  Returns the number of

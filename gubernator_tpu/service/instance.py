@@ -123,6 +123,13 @@ class InstanceConfig:
     # peer clients consult before every RPC.
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     fault_injector: Optional[object] = None
+    # Multi-region GLOBAL federation (docs/federation.md): inter-region
+    # bounded-staleness envelope exchange over the breaker path.  Off by
+    # default; requires data_center (setup_daemon_config enforces it).
+    federation_enabled: bool = False
+    federation_interval: float = 1.0
+    federation_batch_limit: int = 1000
+    federation_timeout: float = 1.0
 
     @classmethod
     def from_config(cls, conf: Config, advertise_address: str = "", **kw):
@@ -158,6 +165,10 @@ class InstanceConfig:
             tpu_global_mesh_capacity=conf.tpu_global_mesh_capacity,
             loader=conf.loader,
             store=conf.store,
+            federation_enabled=conf.federation_enabled,
+            federation_interval=conf.federation_interval,
+            federation_batch_limit=conf.federation_batch_limit,
+            federation_timeout=conf.federation_timeout,
             **kw,
         )
 
@@ -294,6 +305,18 @@ class V1Instance:
         self.global_mgr = GlobalManager(
             self, conf.behaviors, self.metrics, resilience=conf.resilience
         )
+        # Inter-region federation (docs/federation.md): constructed only
+        # when GUBER_FEDERATION_ENABLED is set AND this node knows its
+        # own datacenter — the transport rejects FederationSync frames
+        # (and MULTI_REGION items, _get_rate_limits) when None.  Wired
+        # into the GlobalManager so every owner-side GLOBAL update feeds
+        # the inter-region pending buffers.
+        self.federation = None
+        if conf.federation_enabled and conf.data_center:
+            from gubernator_tpu.federation import FederationManager
+
+            self.federation = FederationManager(self, metrics=self.metrics)
+        self.global_mgr.federation = self.federation
         # GLOBAL collectives data plane: use the shared engine if provided,
         # else build one when GUBER_TPU_GLOBAL_MESH_NODES asks for it.
         self.global_mesh = conf.global_mesh
@@ -495,6 +518,27 @@ class V1Instance:
                 self.metrics.check_error_counter.labels(error="Invalid request").inc()
                 out[i] = RateLimitResponse(error=algorithm_error(req.algorithm))
                 continue
+            if has_behavior(req.behavior, Behavior.MULTI_REGION):
+                # Edge validation (docs/federation.md): past this point
+                # MULTI_REGION is a silent no-op bit, so a node that
+                # cannot federate must say so per item rather than
+                # quietly serving region-local answers forever.
+                if self.federation is None:
+                    self.metrics.check_error_counter.labels(
+                        error="Invalid request").inc()
+                    out[i] = RateLimitResponse(
+                        error="Behavior.MULTI_REGION requires "
+                        "GUBER_DATA_CENTER and GUBER_FEDERATION_ENABLED "
+                        "on this node"
+                    )
+                    continue
+                # MULTI_REGION rides the GLOBAL plane inside the region:
+                # region-local answer now, inter-region envelope later.
+                req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, True)
+                if self.federation.is_degraded():
+                    # A peer region is unreachable: this answer may
+                    # over-admit up to the staleness budget.
+                    self.metrics.federation_degraded_answers.inc()
             if req.created_at is None or req.created_at == 0:
                 req.created_at = created_at
             if self.conf.behaviors.force_global:
@@ -1149,6 +1193,7 @@ class V1Instance:
             metrics=self.metrics,
             resilience=self.conf.resilience,
             fault_injector=self.conf.fault_injector,
+            self_address=self.conf.advertise_address,
         )
 
     def get_peer(self, key: str) -> Optional[PeerClient]:
@@ -1186,6 +1231,12 @@ class V1Instance:
                 *list(self._transfer_tasks), return_exceptions=True
             )
         await self.global_mgr.close(drain_timeout=self.conf.drain_timeout)
+        if self.federation is not None:
+            # After the GLOBAL drain (its final flush may queue the last
+            # deltas here) and before peers shut down (the drain sends
+            # envelopes through them).
+            await self.federation.close(
+                drain_timeout=self.conf.drain_timeout)
         if self._mesh_task is not None:
             self._mesh_task.cancel()
             try:

@@ -94,8 +94,13 @@ class PeerClient:
         resilience: Optional[ResilienceConfig] = None,
         fault_injector=None,
         clock=time.monotonic,
+        self_address: str = "",
     ):
         self._info = info
+        # This node's own advertise address: the caller identity handed
+        # to the fault injector so directional (asymmetric) schedules can
+        # fail one direction of a peer pair only.
+        self.self_address = self_address
         self.behaviors = behaviors or BehaviorConfig()
         self.credentials = channel_credentials
         self.metrics = metrics
@@ -266,7 +271,8 @@ class PeerClient:
             hdrs[DEADLINE_METADATA_KEY] = budget
         try:
             if self.faults is not None:
-                await self.faults.before_rpc(addr, "GetPeerRateLimits")
+                await self.faults.before_rpc(
+                    addr, "GetPeerRateLimits", from_peer=self.self_address)
             out = await stub.GetPeerRateLimits(
                 msg,
                 timeout=timeout,
@@ -304,7 +310,8 @@ class PeerClient:
             g.status.CopyFrom(convert.resp_to_pb(u.status))
         try:
             if self.faults is not None:
-                await self.faults.before_rpc(addr, "UpdatePeerGlobals")
+                await self.faults.before_rpc(
+                    addr, "UpdatePeerGlobals", from_peer=self.self_address)
             await stub.UpdatePeerGlobals(msg, timeout=self.behaviors.global_timeout)
         except grpc.aio.AioRpcError as e:
             self.breaker.record_failure()
@@ -342,7 +349,8 @@ class PeerClient:
         rpc = self._lease_raw("LeaseGrant")
         try:
             if self.faults is not None:
-                await self.faults.before_rpc(addr, "LeaseGrant")
+                await self.faults.before_rpc(
+                    addr, "LeaseGrant", from_peer=self.self_address)
             out = await rpc(
                 fastwire.encode_lease_grant_req(list(specs)),
                 timeout=self.behaviors.batch_timeout,
@@ -371,7 +379,8 @@ class PeerClient:
         rpc = self._lease_raw("LeaseSync")
         try:
             if self.faults is not None:
-                await self.faults.before_rpc(addr, "LeaseSync")
+                await self.faults.before_rpc(
+                    addr, "LeaseSync", from_peer=self.self_address)
             out = await rpc(
                 fastwire.encode_lease_sync_req(list(syncs)),
                 timeout=self.behaviors.batch_timeout,
@@ -387,6 +396,40 @@ class PeerClient:
         if acks is None:
             raise RuntimeError("malformed LeaseSync response frame")
         return acks
+
+    async def federation_sync(self, env, timeout: Optional[float] = None):
+        """Ship one federation envelope to this peer (the key owner in a
+        *remote* region) and return its FederationAck.  Breaker-gated
+        like every peer RPC — the per-region breaker IS this peer's
+        breaker, since the sender routes a region's keys to one owning
+        peer per flush (docs/federation.md)."""
+        from gubernator_tpu.transport import fastwire
+
+        addr = self._info.grpc_address
+        if not self.breaker.allow():
+            msg_ = f"circuit breaker open for peer {addr}"
+            self.last_errs.record(msg_)
+            raise BreakerOpenError(msg_)
+        rpc = self._lease_raw("FederationSync")
+        try:
+            if self.faults is not None:
+                await self.faults.before_rpc(
+                    addr, "FederationSync", from_peer=self.self_address)
+            out = await rpc(
+                fastwire.encode_federation_envelope(env),
+                timeout=timeout if timeout else self.behaviors.batch_timeout,
+            )
+        except grpc.aio.AioRpcError as e:
+            self.breaker.record_failure()
+            self.last_errs.record(
+                f"while federating to peer {addr}: {e.details()}"
+            )
+            raise
+        self.breaker.record_success()
+        ack = fastwire.parse_federation_ack(out)
+        if ack is None:
+            raise RuntimeError("malformed FederationSync response frame")
+        return ack
 
     def get_last_err(self) -> List[str]:
         return self.last_errs.errors()

@@ -82,8 +82,8 @@ def columns_from_pb(ms) -> tuple:
     Returns ``(cols, errors, special)``: per-item validation errors
     (empty name/unique_key, the reference's error-in-item convention,
     gubernator.go:208-216) and ``special`` = True when any item carries
-    GLOBAL behavior or metadata (trace context) — those need the
-    object-routing path.  ``created_at == 0`` means "server stamps now"
+    GLOBAL or MULTI_REGION behavior or metadata (trace context) — those
+    need the object-routing path.  ``created_at == 0`` means "server stamps now"
     (matching V1Instance's object path, gubernator.go:218-220).
     """
     import numpy as np
@@ -95,7 +95,7 @@ def columns_from_pb(ms) -> tuple:
     n = len(ms)
     if n == 0:
         return ReqColumns.empty(), {}, False
-    GLOBAL = int(Behavior.GLOBAL)
+    SPECIAL = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
     keys: List[bytes] = [b""] * n
     hits = [0] * n
     limit = [0] * n
@@ -129,7 +129,7 @@ def columns_from_pb(ms) -> tuple:
         b = behavior[i] = m.behavior
         created[i] = m.created_at or CREATED_UNSET
         burst[i] = m.burst
-        if (b & GLOBAL) or m.metadata:
+        if (b & SPECIAL) or m.metadata:
             special = True
     a = lambda v: np.asarray(v, np.int64)  # noqa: E731
     blob, offsets = pack_blob(keys)

@@ -317,6 +317,22 @@ class V1Servicer:
         acks = await self.instance.lease_sync(syncs)
         return fastwire.encode_lease_sync_resp(acks)
 
+    async def FederationSync(self, raw: bytes, context):
+        """Inter-region envelope edge (docs/federation.md): GFE1 frame
+        in, GFA1 ack out.  A node without federation enabled rejects the
+        RPC — the sender's breaker treats it like any dead peer."""
+        env = fastwire.parse_federation_envelope(raw)
+        if env is None:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "malformed FederationSync frame")
+        if self.instance.federation is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "federation is not enabled on this node")
+        ack = await self.instance.federation.receive(env)
+        return fastwire.encode_federation_ack(ack)
+
 
 class PeersServicer:
     """pb ↔ dataclass edge for the peer service.

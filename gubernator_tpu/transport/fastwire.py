@@ -45,7 +45,11 @@ _KEY_EMPTY = 2
 _HAS_METADATA = 4
 _HAS_CREATED = 8
 
-_GLOBAL = int(Behavior.GLOBAL)
+# Behaviors that force the object-routing path: GLOBAL (owner routing +
+# reconcile queues) and MULTI_REGION (federation validation — the edge
+# must reject it per-item when federation is off, which the columns
+# fast path cannot express).
+_SPECIAL_BEHAVIOR = int(Behavior.GLOBAL) | int(Behavior.MULTI_REGION)
 
 _lib = None
 _load_attempted = False
@@ -100,9 +104,10 @@ def parse_req(
 ) -> Optional[Tuple[ReqColumns, Dict[int, str], bool]]:
     """Serialized ``GetRateLimitsReq`` → (cols, per-item errors, special).
 
-    ``special`` is True when any item carries GLOBAL behavior or metadata
-    (those route through the object path, which re-parses with protobuf —
-    the codec records metadata *presence* only).  Returns None when the
+    ``special`` is True when any item carries GLOBAL or MULTI_REGION
+    behavior or metadata (those route through the object path, which
+    re-parses with protobuf — the codec records metadata *presence*
+    only).  Returns None when the
     native library is unavailable or the bytes are malformed (caller
     falls back to ``pb.GetRateLimitsReq.FromString``).
 
@@ -179,7 +184,7 @@ def parse_req(
             errors.setdefault(int(i), algorithm_error(algorithm[i]))
     # guber: allow-G001(flags/behavior are host numpy, never device)
     special = bool((flags & _HAS_METADATA).any()) or bool(
-        (behavior & _GLOBAL).any()
+        (behavior & _SPECIAL_BEHAVIOR).any()
     )
     # The key blob stays a view into the decode buffer — the last copy
     # on the decode path is gone.  Arena-backed batches alias the slab
@@ -489,5 +494,97 @@ def parse_lease_sync_resp(data: bytes):
                 accepted=bool(accepted), generation=gen,
                 credited=credited, charged=charged))
         return out if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# Multi-region federation frames (docs/federation.md).
+#
+# Envelope exchange happens once per GUBER_FEDERATION_INTERVAL per remote
+# region — WAN cadence, not decision cadence — so like the lease frames
+# these are pure-Python struct codecs.  The version rides the magic
+# (GFE1/GFA1): a receiver that doesn't recognize the magic rejects the
+# RPC, which the sender's breaker/redelivery path treats like any other
+# failure — a mixed-version fleet degrades to intra-region-only instead
+# of corrupting state.
+
+_FED_ENVELOPE_MAGIC = b"GFE1"
+_FED_ACK_MAGIC = b"GFA1"
+
+
+def encode_federation_envelope(env) -> bytes:
+    """FederationEnvelope → GFE1 frame."""
+    parts = [
+        _FED_ENVELOPE_MAGIC,
+        _struct.pack("<q", env.seq),
+        _pack_str(env.origin),
+        _pack_str(env.region),
+        _struct.pack("<I", len(env.records)),
+    ]
+    for rec in env.records:
+        parts.append(_struct.pack(
+            "<qqqqqqq", rec.hits, rec.limit, rec.duration, rec.algorithm,
+            rec.behavior, rec.burst, rec.created_at))
+        parts.append(_pack_str(rec.name))
+        parts.append(_pack_str(rec.unique_key))
+    return b"".join(parts)
+
+
+def parse_federation_envelope(data: bytes):
+    """GFE1 frame → FederationEnvelope (None when malformed)."""
+    from gubernator_tpu.federation.envelope import (
+        FederationEnvelope,
+        FederationRecord,
+    )
+
+    try:
+        if data[:4] != _FED_ENVELOPE_MAGIC:
+            return None
+        (seq,) = _struct.unpack_from("<q", data, 4)
+        off = 12
+        origin, off = _unpack_str(data, off)
+        region, off = _unpack_str(data, off)
+        (n,) = _struct.unpack_from("<I", data, off)
+        off += 4
+        records = []
+        for _ in range(n):
+            hits, limit, duration, algo, behavior, burst, created = (
+                _struct.unpack_from("<qqqqqqq", data, off))
+            off += 56
+            name, off = _unpack_str(data, off)
+            key, off = _unpack_str(data, off)
+            records.append(FederationRecord(
+                name=name, unique_key=key, hits=hits, limit=limit,
+                duration=duration, algorithm=algo, behavior=behavior,
+                burst=burst, created_at=created))
+        env = FederationEnvelope(
+            origin=origin, region=region, seq=seq, records=records)
+        return env if off == len(data) else None
+    except (_struct.error, IndexError, UnicodeDecodeError):
+        return None
+
+
+def encode_federation_ack(ack) -> bytes:
+    """FederationAck → GFA1 frame."""
+    return b"".join([
+        _FED_ACK_MAGIC,
+        _struct.pack("<qq", ack.seq, ack.applied),
+        _pack_str(ack.origin),
+    ])
+
+
+def parse_federation_ack(data: bytes):
+    """GFA1 frame → FederationAck (None when malformed)."""
+    from gubernator_tpu.federation.envelope import FederationAck
+
+    try:
+        if data[:4] != _FED_ACK_MAGIC:
+            return None
+        seq, applied = _struct.unpack_from("<qq", data, 4)
+        off = 20
+        origin, off = _unpack_str(data, off)
+        ack = FederationAck(origin=origin, seq=seq, applied=applied)
+        return ack if off == len(data) else None
     except (_struct.error, IndexError, UnicodeDecodeError):
         return None

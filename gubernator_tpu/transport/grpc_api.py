@@ -63,6 +63,16 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
                     response_serializer=lambda m: m,
                 ),
             } if hasattr(servicer, "LeaseGrant") else {}),
+            # Federation envelope exchange (docs/federation.md): raw
+            # GFE1/GFA1 frames, registered only when the servicer wires
+            # a FederationManager.
+            **({
+                "FederationSync": grpc.unary_unary_rpc_method_handler(
+                    servicer.FederationSync,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda m: m,
+                ),
+            } if hasattr(servicer, "FederationSync") else {}),
         },
     )
 
@@ -112,6 +122,11 @@ class V1Stub:
         )
         self.LeaseSync = channel.unary_unary(
             f"/{V1_SERVICE}/LeaseSync",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self.FederationSync = channel.unary_unary(
+            f"/{V1_SERVICE}/FederationSync",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )

@@ -613,6 +613,40 @@ class Metrics:
             ["result"],
             registry=reg,
         )
+        # Multi-region federation (docs/federation.md): envelope traffic,
+        # redelivery attempts, worst-case cross-region drift age, and
+        # MULTI_REGION answers served while a peer region was down.
+        self.federation_envelopes = Counter(
+            "gubernator_tpu_federation_envelopes",
+            "Federation envelopes by outcome: \"sent\" (acked by the "
+            "remote owning peer), \"applied\" (received from a peer "
+            "region and applied locally), \"duplicate\" (received again "
+            "after a lost ack; acked without re-applying).",
+            ["result"],
+            registry=reg,
+        )
+        self.federation_redeliveries = Counter(
+            "gubernator_tpu_federation_redeliveries",
+            "Federation envelope send attempts that failed (breaker "
+            "open, RPC error, malformed ack) and will retry the same "
+            "envelope after a jittered backoff.",
+            registry=reg,
+        )
+        self.federation_staleness = Gauge(
+            "gubernator_tpu_federation_staleness_seconds",
+            "Age of the oldest cross-region hit delta not yet acked by "
+            "its target region (pending or in flight); the live bound "
+            "on inter-region over-admission drift.",
+            registry=reg,
+        )
+        self.federation_degraded_answers = Counter(
+            "gubernator_tpu_federation_degraded_answers",
+            "MULTI_REGION requests answered from region-local state "
+            "while at least one peer region was unreachable (its "
+            "channel failing or breaker open) — each may over-admit up "
+            "to the staleness budget.",
+            registry=reg,
+        )
         self.loop_restarts = Counter(
             "gubernator_loop_restarts",
             "Background loops (global_hits, global_broadcast, peer_batch) "

@@ -108,6 +108,17 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #                                        algorithms stays ONE device
 #                                        dispatch, never per-algorithm
 #                                        sub-batches
+#   federation_hit_loss_after_heal 0   — the federation_2r rung's two
+#                                        regions converge on the exact
+#                                        union of all partition-era hits
+#                                        after the heal (docs/federation.md
+#                                        exactly-once envelope replay)
+#   federation_over_admission_ratio <=1.0 — partition-era over-admission
+#                                        on the contended key stays within
+#                                        the staleness budget: each
+#                                        isolated region admits at most
+#                                        one limit's worth, so a 2-region
+#                                        split caps the extra at 1.0x
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -137,6 +148,8 @@ COUNT_KEYS = (
     "multiproc_dropped_acked",
     "mixed_algo_parity_errors",
     "mixed_algo_dispatches_per_step",
+    "federation_hit_loss_after_heal",
+    "federation_over_admission_ratio",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -246,6 +259,11 @@ ABSOLUTE_MAX_KEYS = {
     # growth across the rung stays bounded by the two RAM tiers no
     # matter what the baseline measured.
     "churn_ssd_rss_mb": 512,
+    # A 2-region partition admits at most one extra limit's worth on a
+    # contended key (staleness × local rate, and each isolated region
+    # stops at its own limit) — above 1.0 the region-local answer path
+    # stopped enforcing the local limit during a partition.
+    "federation_over_admission_ratio": 1.0,
 }
 
 GATED_VALUE_KEYS = (
@@ -284,6 +302,7 @@ ABSOLUTE_ZERO_KEYS = (
     "multiproc_double_served",
     "multiproc_dropped_acked",
     "mixed_algo_parity_errors",
+    "federation_hit_loss_after_heal",
 )
 
 

@@ -60,8 +60,11 @@ async def poll_consumed(daemon, name, key, want, limit=1_000_000,
 
     async def poll():
         while True:
+            # Per-RPC deadline above the poll budget: a first-compile
+            # stall on a loaded single-core host must surface as a slow
+            # poll, not a DEADLINE_EXCEEDED crash out of the helper.
             r = (await client.get_rate_limits(
-                [req(name, key, hits=0, limit=limit)]
+                [req(name, key, hits=0, limit=limit)], timeout=30.0
             ))[0]
             if limit - r.remaining == want:
                 return r
@@ -105,20 +108,23 @@ async def test_chaos_100pct_failure_degrades_then_redelivers():
         # were re-enqueued instead of dropped.
         await c.wait_for_metric(
             ni, "gubernator_breaker_transitions_total",
-            labels={"peerAddr": owner_addr, "to": "open"},
+            labels={"peerAddr": owner_addr, "to": "open"}, timeout=30,
         )
-        await c.wait_for_metric(ni, "gubernator_global_redelivered_hits_total")
+        await c.wait_for_metric(
+            ni, "gubernator_global_redelivered_hits_total", timeout=30)
         assert c.metric_value(ni, "gubernator_degraded_answers_total") >= 1
         assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
 
         # Recovery: (b) every hit lands on the owner — zero loss.
         inj.clear()
-        await poll_consumed(owner, name, key, sent)
+        await poll_consumed(owner, name, key, sent, timeout=60)
         assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
-        # The breaker closed again after a successful probe.
+        # The breaker closed again after a successful probe.  Generous
+        # budget: the half-open probe rides the backoff schedule, and the
+        # suite shares one CPU core.
         await c.wait_for_metric(
             ni, "gubernator_breaker_transitions_total",
-            labels={"peerAddr": owner_addr, "to": "closed"},
+            labels={"peerAddr": owner_addr, "to": "closed"}, timeout=30,
         )
         # (c) nothing died.
         assert_no_loop_dead(c)
@@ -214,7 +220,7 @@ async def test_chaos_kill_peer_mid_flush_redelivers_after_restart():
 
         # Resurrect the owner on its old port; redelivery drains into it.
         owner = await c.restart(owner_idx)
-        await poll_consumed(owner, name, key, sent, timeout=15)
+        await poll_consumed(owner, name, key, sent, timeout=60)
         assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
         assert_no_loop_dead(c)
     finally:
@@ -222,8 +228,11 @@ async def test_chaos_kill_peer_mid_flush_redelivers_after_restart():
 
 
 async def test_chaos_intermittent_errors_recover_without_loss():
-    """50% injected error rate (seeded): slower, flappier — but the
-    accounting still converges to zero loss and the loops survive."""
+    """50% injected error rate (seeded), *asymmetric*: only the
+    non-owner → owner direction fails (the directional WAN-style
+    schedule); the owner's own outbound broadcasts are clean.  Slower,
+    flappier — but the accounting still converges to zero loss and the
+    loops survive."""
     behaviors, resilience = fast_chaos_conf()
     inj = FaultInjector(seed=23)
     c = await Cluster.start(2, behaviors=behaviors, resilience=resilience,
@@ -232,19 +241,29 @@ async def test_chaos_intermittent_errors_recover_without_loss():
         name, key = "chaos-flap", "fk"
         owner = c.find_owning_daemon(name, key)
         non_owner = c.list_non_owning_daemons(name, key)[0]
-        inj.set_fault(owner.conf.grpc_listen_address, error_rate=0.5)
+        inj.set_fault(owner.conf.grpc_listen_address,
+                      from_peer=non_owner.advertise_address,
+                      error_rate=0.5)
+        # The reverse direction is untouched: broadcasts owner → non_owner
+        # must never be counted against this schedule.
+        assert inj.spec_for(
+            non_owner.conf.grpc_listen_address,
+            from_peer=owner.advertise_address) is None
 
         client = non_owner.client()
         sent = 0
         for _ in range(25):
-            out = await client.get_rate_limits([req(name, key)])
+            out = await client.get_rate_limits([req(name, key)], timeout=30.0)
             assert out[0].error == ""
             sent += 1
             await asyncio.sleep(0.004)
         await client.close()
 
         inj.clear()
-        await poll_consumed(owner, name, key, sent)
+        # Generous budget: at 50% injected errors the flush can need
+        # several backoff rounds, the poll client pays a fresh channel +
+        # first-compile on its first RPC, and the suite shares one core.
+        await poll_consumed(owner, name, key, sent, timeout=60)
         ni = c.daemons.index(non_owner)
         assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
         assert_no_loop_dead(c)
@@ -286,8 +305,15 @@ async def test_chaos_peer_death_mid_reshard_defined_state():
         await client.close()
         await c.wait_for_metric(
             ni, "gubernator_breaker_transitions_total",
-            labels={"peerAddr": owner_addr, "to": "open"},
+            labels={"peerAddr": owner_addr, "to": "open"}, timeout=30,
         )
+        # Pin the breaker open across the abort check: fast_chaos_conf's
+        # 50ms open window can slip to HALF_OPEN between the metric wait
+        # and the coordinator's breaker_check on a loaded host, and
+        # is_open() is False in HALF_OPEN.
+        for p in non_owner.instance.get_peer_list():
+            if p._info.grpc_address == owner_addr:
+                p.breaker.force_open(10.0)
 
         # The transition aborts on the open breaker, before any state
         # moves: a defined outcome, never an exception.
@@ -313,7 +339,7 @@ async def test_chaos_peer_death_mid_reshard_defined_state():
         inj.clear()
         await c.wait_for_metric(
             ni, "gubernator_breaker_transitions_total",
-            labels={"peerAddr": owner_addr, "to": "closed"},
+            labels={"peerAddr": owner_addr, "to": "closed"}, timeout=30,
         )
         before = non_owner.instance.engine.cache_size()
         res = await non_owner.instance.reshard(2)
@@ -333,8 +359,100 @@ async def test_chaos_peer_death_mid_reshard_defined_state():
         # The in-flight GLOBAL state rode through both transitions: every
         # buffered hit redelivers to the recovered owner — zero loss,
         # zero double-serves on the bucket itself.
-        await poll_consumed(owner, name, key, sent)
+        await poll_consumed(owner, name, key, sent, timeout=60)
         assert c.metric_value(ni, "gubernator_global_dropped_hits_total") == 0
+        assert_no_loop_dead(c)
+    finally:
+        await c.stop()
+
+
+def _isolate_regions(inj, c, a="us", b="eu"):
+    """Cut every cross-region link with directional schedules — intra-
+    region traffic keeps flowing, exactly what a WAN partition does."""
+    for da in c.daemons:
+        for db in c.daemons:
+            if da.conf.data_center == a and db.conf.data_center == b:
+                inj.set_fault(db.conf.grpc_listen_address,
+                              from_peer=da.advertise_address,
+                              partition=True)
+                inj.set_fault(da.conf.grpc_listen_address,
+                              from_peer=db.advertise_address,
+                              partition=True)
+
+
+async def test_chaos_region_isolation_degrades_heals_zero_loss():
+    """The federation acceptance run (docs/federation.md): two regions,
+    healthy exchange first, then a full WAN partition (directional
+    schedules — intra-region links stay up), bounded degraded serving
+    on both sides, then heal.  After the heal both regions converge on
+    the union of all hits: ABSOLUTE_ZERO hit loss, no double-counts."""
+    behaviors, resilience = fast_chaos_conf()
+    inj = FaultInjector(seed=13)
+    c = await Cluster.start(
+        4, datacenters=["us", "us", "eu", "eu"], behaviors=behaviors,
+        resilience=resilience, fault_injector=inj, federation=True,
+        federation_interval=0.02,
+    )
+    try:
+        name, key = "chaos-fed", "gk"
+        us_owner = c.find_owning_daemon_in_region(name, key, "us")
+        eu_owner = c.find_owning_daemon_in_region(name, key, "eu")
+        ui, ei = c.daemons.index(us_owner), c.daemons.index(eu_owner)
+
+        def mr_req(hits=1):
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=1_000_000,
+                duration=3_600_000, behavior=Behavior.MULTI_REGION,
+            )
+
+        async def drive(daemon, n):
+            client = daemon.client()
+            for _ in range(n):
+                # Generous RPC deadline: four engines JIT their first
+                # programs during this test on a shared CPU host.
+                out = await client.get_rate_limits([mr_req()], timeout=30.0)
+                assert out[0].error == ""
+                await asyncio.sleep(0.002)
+            await client.close()
+
+        # Healthy path: us hits show up in eu via the envelope stream.
+        await drive(us_owner, 5)
+        await c.wait_for_metric(
+            ei, "gubernator_tpu_federation_envelopes_total",
+            labels={"result": "applied"}, timeout=30)
+        await poll_consumed(eu_owner, name, key, 5, timeout=60)
+
+        # WAN partition: both regions keep serving, deltas buffer.
+        _isolate_regions(inj, c)
+        await drive(us_owner, 10)
+        await drive(eu_owner, 7)
+        # The sender noticed (redelivery attempts on the same envelope)
+        # and flags MULTI_REGION answers as degraded.
+        await c.wait_for_metric(
+            ui, "gubernator_tpu_federation_redeliveries_total", timeout=30)
+        await drive(us_owner, 3)
+        assert c.metric_value(
+            ui, "gubernator_tpu_federation_degraded_answers_total") >= 1
+        # Degraded, never down: each region still answers from local
+        # state — drift is bounded by staleness × local rate, which the
+        # staleness gauge now exports.
+        assert c.metric_value(
+            ui, "gubernator_tpu_federation_staleness_seconds") > 0
+
+        # Heal: buffered envelopes replay; the receive ledger dedupes
+        # redeliveries; both regions converge on the union of all hits.
+        inj.clear()
+        total = 5 + 10 + 7 + 3
+        await poll_consumed(us_owner, name, key, total, timeout=60)
+        await poll_consumed(eu_owner, name, key, total, timeout=60)
+        # Exactly-once: nothing pending, nothing lost, nothing doubled —
+        # poll_consumed above asserted the == (over-admission would
+        # overshoot, loss would undershoot).
+        for d in (us_owner, eu_owner):
+            fed = d.instance.federation
+            assert fed is not None
+            assert fed.pending_keys() == 0
+            assert not fed._task.done()
         assert_no_loop_dead(c)
     finally:
         await c.stop()

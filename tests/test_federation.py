@@ -1,0 +1,283 @@
+"""Multi-region federation unit tests (docs/federation.md).
+
+The envelope merge discipline is the whole correctness story for the
+inter-region exchange: commutative additive deltas + per-channel
+sequence dedup means any interleaving with any number of redeliveries
+converges to the same totals.  These tests fuzz that claim directly,
+then cover the edges around it — the wire frames, the MULTI_REGION
+edge validation, and the region scoping of transfer_ownership.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from gubernator_tpu.federation.envelope import (
+    FederationEnvelope,
+    FederationRecord,
+    ReceiveLedger,
+    merge_records,
+)
+from gubernator_tpu.types import Behavior, PeerInfo, RateLimitRequest
+
+
+def _rec(key: str, hits: int, behavior: int = 0) -> FederationRecord:
+    return FederationRecord(
+        name="fed", unique_key=key, hits=hits, limit=1000,
+        duration=60_000, behavior=behavior,
+    )
+
+
+# ----------------------------------------------------------------------
+# Envelope merge: commutative + idempotent (the tentpole's core claim)
+# ----------------------------------------------------------------------
+def test_envelope_merge_commutes_and_dedups_fuzz():
+    """Random envelope streams from several origins, applied in random
+    interleavings with random duplicate redeliveries, must all converge
+    to the same per-key totals — the exact sum of every origin's deltas,
+    each counted once."""
+    rng = random.Random(20260807)
+    origins = ["node-a:81", "node-b:81", "node-c:81"]
+    keys = [f"k{i}" for i in range(6)]
+
+    # Each origin emits a numbered stream of envelopes (seq from 1, the
+    # sender discipline).
+    streams = {}
+    expected = {k: 0 for k in keys}
+    for origin in origins:
+        envs = []
+        for seq in range(1, rng.randint(4, 9)):
+            records = [
+                _rec(k, rng.randint(1, 7))
+                for k in rng.sample(keys, rng.randint(1, len(keys)))
+            ]
+            for r in records:
+                expected[r.unique_key] += r.hits
+            envs.append(FederationEnvelope(
+                origin=origin, region="us", seq=seq, records=records))
+        streams[origin] = envs
+
+    for trial in range(20):
+        # Interleave: in-order per channel (the sender never advances seq
+        # without an ack) but arbitrary across channels, with duplicates
+        # injected anywhere at or below the already-delivered seq.
+        cursors = {o: 0 for o in origins}
+        ledger = ReceiveLedger()
+        totals = {k: 0 for k in keys}
+
+        def apply(env):
+            if not ledger.admit(env):
+                return
+            for r in env.records:
+                totals[r.unique_key] += r.hits
+
+        while any(cursors[o] < len(streams[o]) for o in origins):
+            o = rng.choice(origins)
+            if cursors[o] < len(streams[o]):
+                apply(streams[o][cursors[o]])
+                cursors[o] += 1
+            # Random redelivery of an already-delivered envelope on a
+            # random channel (the lost-ack case).
+            if rng.random() < 0.5:
+                od = rng.choice(origins)
+                if cursors[od]:
+                    apply(streams[od][rng.randrange(cursors[od])])
+
+        assert totals == expected, f"trial {trial} diverged"
+        for o in origins:
+            assert ledger.last(o) == len(streams[o])
+
+
+def test_record_merge_last_writer_config_and_reset_or():
+    a = _rec("k", 3)
+    b = _rec("k", 4, behavior=int(Behavior.RESET_REMAINING))
+    b.limit = 77
+    a.merge(b)
+    assert a.hits == 7
+    assert a.limit == 77  # config is last-writer-wins
+    assert a.behavior & int(Behavior.RESET_REMAINING)  # sticky OR
+    a.merge(_rec("k", 1))
+    assert a.hits == 8
+    assert a.behavior & int(Behavior.RESET_REMAINING)  # never cleared
+
+
+def test_ledger_failed_apply_admits_retry():
+    """mark() is separate from seen() so an apply that dies mid-flight
+    leaves the seq unmarked — the sender's retry of the SAME envelope
+    must be admitted, not treated as a duplicate."""
+    led = ReceiveLedger()
+    env = FederationEnvelope(origin="o:1", seq=1, records=[_rec("k", 2)])
+    assert not led.seen(env)   # first delivery: apply...
+    # ...apply fails; mark() never runs; the retry is admitted:
+    assert not led.seen(env)
+    led.mark(env)              # retry succeeds
+    assert led.seen(env)       # third delivery (lost ack): no-op
+    assert led.last("o:1") == 1
+
+
+def test_merge_records_bounds_distinct_keys_not_hits():
+    """A full pending buffer drops NEW keys only — tracked keys always
+    absorb their delta, so a long partition loses nothing for keys
+    already buffered."""
+    into = {}
+    merged, dropped = merge_records(
+        into, [_rec("a", 1), _rec("b", 1)], limit=2)
+    assert (merged, dropped) == (2, 0)
+    merged, dropped = merge_records(
+        into, [_rec("a", 5), _rec("c", 1)], limit=2)
+    assert (merged, dropped) == (1, 1)
+    assert into["fed_a"].hits == 6
+    assert "fed_c" not in into
+
+
+# ----------------------------------------------------------------------
+# Wire frames (pure-Python struct codecs; transport/fastwire.py)
+# ----------------------------------------------------------------------
+def test_federation_wire_roundtrip():
+    from gubernator_tpu.federation.envelope import FederationAck
+    from gubernator_tpu.transport import fastwire
+
+    env = FederationEnvelope(
+        origin="10.0.0.1:81", region="eu", seq=42,
+        records=[
+            _rec("k1", 3),
+            FederationRecord(name="Ω≈", unique_key="ключ", hits=-2,
+                             limit=2 ** 62, duration=1,
+                             algorithm=1, behavior=10, burst=7,
+                             created_at=123456789),
+        ],
+    )
+    back = fastwire.parse_federation_envelope(
+        fastwire.encode_federation_envelope(env))
+    assert back == env
+
+    ack = FederationAck(origin="10.0.0.1:81", seq=42, applied=2)
+    assert fastwire.parse_federation_ack(
+        fastwire.encode_federation_ack(ack)) == ack
+
+    # Malformed frames parse to None, never raise.
+    data = fastwire.encode_federation_envelope(env)
+    assert fastwire.parse_federation_envelope(b"") is None
+    assert fastwire.parse_federation_envelope(b"XXXX" + data[4:]) is None
+    assert fastwire.parse_federation_envelope(data[:-1]) is None
+    assert fastwire.parse_federation_envelope(data + b"\0") is None
+    assert fastwire.parse_federation_ack(data) is None
+    assert fastwire.parse_federation_ack(b"GFA1\x01") is None
+
+
+# ----------------------------------------------------------------------
+# MULTI_REGION at the edge
+# ----------------------------------------------------------------------
+def test_multi_region_is_special_on_both_decode_paths():
+    """MULTI_REGION items must route through the object path (where the
+    edge validation lives) on the protobuf path and the native wire fast
+    path alike."""
+    from gubernator_tpu.pb import gubernator_pb2 as pb
+    from gubernator_tpu.transport import convert, fastwire
+
+    ms = [pb.RateLimitReq(name="mr", unique_key="k", hits=1,
+                          behavior=int(Behavior.MULTI_REGION))]
+    _, errors, special = convert.columns_from_pb(ms)
+    assert not errors and special
+
+    if fastwire.load() is not None:
+        data = pb.GetRateLimitsReq(requests=ms).SerializeToString()
+        out = fastwire.parse_req(data)
+        assert out is not None
+        _, errors, special = out
+        assert not errors and special
+
+
+def test_multi_region_rejected_per_item_without_federation():
+    """A node that cannot federate rejects MULTI_REGION items per-item
+    (never silently serving region-local answers forever); other items
+    in the batch still serve."""
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+
+    async def run():
+        inst = await V1Instance.create(InstanceConfig(cache_size=256))
+        try:
+            assert inst.federation is None
+            out = await inst.get_rate_limits([
+                RateLimitRequest(
+                    name="mr", unique_key="k", hits=1, limit=10,
+                    duration=60_000, behavior=Behavior.MULTI_REGION),
+                RateLimitRequest(
+                    name="plain", unique_key="k", hits=1, limit=10,
+                    duration=60_000),
+            ])
+            assert "MULTI_REGION requires" in out[0].error
+            assert "GUBER_DATA_CENTER" in out[0].error
+            assert out[1].error == "" and out[1].remaining == 9
+        finally:
+            await inst.close()
+
+    asyncio.run(run())
+
+
+def test_federation_enabled_requires_data_center():
+    from gubernator_tpu.config import setup_daemon_config
+
+    with pytest.raises(ValueError, match="GUBER_DATA_CENTER"):
+        setup_daemon_config(environ={"GUBER_FEDERATION_ENABLED": "true"})
+    conf = setup_daemon_config(environ={
+        "GUBER_FEDERATION_ENABLED": "true",
+        "GUBER_DATA_CENTER": "us-east-1",
+        "GUBER_FEDERATION_INTERVAL": "250ms",
+    })
+    assert conf.config.federation_enabled
+    assert conf.config.federation_interval == 0.25
+
+
+# ----------------------------------------------------------------------
+# transfer_ownership stays region-scoped (satellite 3 regression)
+# ----------------------------------------------------------------------
+def test_transfer_ownership_never_pushes_cross_region():
+    """Ring churn handoff resolves new owners through the LOCAL picker
+    only: accumulated GLOBAL state must never be installed on a
+    remote-region peer via raw UpdatePeerGlobals — remote regions
+    converge through the envelope stream (docs/federation.md)."""
+    from gubernator_tpu.service.instance import InstanceConfig, V1Instance
+    from gubernator_tpu.service.peer_client import PeerClient
+
+    async def run():
+        self_addr, us_addr, eu_addr = (
+            "127.0.0.1:9101", "127.0.0.1:9102", "127.0.0.1:9103")
+        inst = await V1Instance.create(InstanceConfig(
+            cache_size=256, data_center="us",
+            advertise_address=self_addr))
+        pushed = []
+        orig = PeerClient.update_peer_globals
+
+        async def spy(self, updates):
+            pushed.append((self.info.grpc_address, len(updates)))
+
+        PeerClient.update_peer_globals = spy
+        try:
+            # Seed owner-side accumulated state while standalone.
+            for i in range(24):
+                r = RateLimitRequest(
+                    name="xfer", unique_key=f"k{i}", hits=2, limit=100,
+                    duration=60_000, behavior=Behavior.GLOBAL)
+                inst.global_mgr._owned[r.hash_key()] = r
+
+            # Ring churn: a second local peer joins, plus a remote-region
+            # peer that MUST stay invisible to the handoff.
+            inst.set_peers([
+                PeerInfo(grpc_address=self_addr, datacenter="us"),
+                PeerInfo(grpc_address=us_addr, datacenter="us"),
+                PeerInfo(grpc_address=eu_addr, datacenter="eu"),
+            ])
+            assert [p.info.grpc_address
+                    for p in inst.region_picker.peers()] == [eu_addr]
+
+            moved = await inst.global_mgr.transfer_ownership()
+            assert moved > 0  # some keys re-hashed to the new local peer
+            assert pushed, "no handoff pushes recorded"
+            assert all(addr == us_addr for addr, _ in pushed), pushed
+        finally:
+            PeerClient.update_peer_globals = orig
+            await inst.close()
+
+    asyncio.run(run())

@@ -9,6 +9,7 @@ windows — no real sleeps longer than the supervisor's 10 ms restart delay.
 """
 
 import asyncio
+import time
 
 import grpc
 import pytest
@@ -402,6 +403,34 @@ async def test_redelivery_buffer_bounded_and_drops_counted():
         assert metrics.sample("gubernator_global_dropped_hits_total") >= 6
         assert metrics.sample("gubernator_global_send_queue_length") == \
             len(mgr._hits)
+    finally:
+        await mgr.close()
+
+
+async def test_queued_hit_sheds_caller_deadline():
+    """The queued flush copy must NOT inherit the caller's admission
+    budget: the client was already answered locally, so nobody is
+    waiting on the flush.  A copy that kept the deadline would make
+    every redelivery raise BudgetExhausted once an owner outage outlives
+    the budget — the buffered hits could then never land (the breaker
+    never even gets a probe), silently breaking zero-loss heal."""
+    mgr = GlobalManager(
+        FakeInstance(FailingPeer()),
+        BehaviorConfig(global_sync_wait=60.0),  # no flush during the test
+        Metrics(),
+        resilience=ResilienceConfig(redelivery_limit=100),
+    )
+    try:
+        r = req(key="dl", behavior=Behavior.GLOBAL)
+        r.deadline = time.monotonic() - 1.0  # budget already spent
+        mgr.queue_hit(r)
+        (queued,) = mgr._hits.values()
+        assert queued.deadline is None
+        assert queued.hits == 1
+        # Aggregation onto the shed copy must not resurrect a deadline.
+        mgr.queue_hit(req(key="dl", behavior=Behavior.GLOBAL))
+        (queued,) = mgr._hits.values()
+        assert queued.deadline is None and queued.hits == 2
     finally:
         await mgr.close()
 

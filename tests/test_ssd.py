@@ -378,9 +378,11 @@ def test_compaction_rewrites_live_rows_then_retires(tmp_path):
 
 
 def test_capacity_retires_oldest_sealed_slab(tmp_path):
-    # Budget below two sealed slabs: the oldest retires wholesale and
-    # its keys become (cache-semantics) misses.
-    s = ssd_store(tmp_path, slab_bytes=1, capacity_bytes=4096)
+    # Budget between one and two sealed slabs (an 8-key record is ~4.4 KB
+    # since the zoo columns joined COLD_FIELDS): the oldest slabs retire
+    # wholesale and their keys become (cache-semantics) misses while the
+    # newest slab stays within budget.
+    s = ssd_store(tmp_path, slab_bytes=1, capacity_bytes=6144)
     try:
         s.put_columns(mkeys(8, "old"), mkcols(8), NOW)
         s.flush()
@@ -388,7 +390,7 @@ def test_capacity_retires_oldest_sealed_slab(tmp_path):
             s.put_columns(mkeys(8, f"g{g}-"), mkcols(8), NOW)
             s.flush()
         assert s.metric_slab_evictions >= 1
-        assert s.bytes_used() <= 4096 + s.slab_bytes
+        assert s.bytes_used() <= 6144 + s.slab_bytes
         pos, _ = s.take_batch(mkeys(8, "old"), NOW)
         assert len(pos) == 0  # oldest slab's rows are gone
         pos, _ = s.take_batch(mkeys(8, "g3-"), NOW)

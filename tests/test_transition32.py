@@ -95,6 +95,9 @@ def to_oracle(state, req):
         status=jnp.asarray(state["status"], jnp.int32),
         expire_at=jnp.asarray(state["expire_at"]),
         in_use=jnp.asarray(state["in_use"]),
+        # Zoo columns (PR 16): token/leaky lanes never read them.
+        tat=jnp.zeros_like(jnp.asarray(state["expire_at"])),
+        prev_count=jnp.zeros_like(jnp.asarray(state["expire_at"])),
     )
     r = ReqBatch(
         slot=jnp.asarray(req["slot"], jnp.int32),
@@ -126,6 +129,9 @@ def to_parts(state, req):
         status=jnp.asarray(state["status"], jnp.int32),
         expire_at=p64.from_np(state["expire_at"]),
         in_use=jnp.asarray(state["in_use"]),
+        # Zoo columns (PR 16): token/leaky lanes never read them.
+        tat=p64.from_np(np.zeros_like(state["expire_at"])),
+        prev_count=p64.from_np(np.zeros_like(state["expire_at"])),
     )
     r = PReq(
         slot=jnp.asarray(req["slot"], jnp.int32),
