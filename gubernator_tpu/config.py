@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from gubernator_tpu.resilience import ResilienceConfig
-from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.types import MAX_BATCH_SIZE, PeerInfo
 
 log = logging.getLogger("gubernator")
 
@@ -749,10 +749,14 @@ def setup_daemon_config(
             f"GUBER_FEDERATION_INTERVAL must be > 0; "
             f"got {conf.federation_interval}"
         )
-    if conf.federation_batch_limit < 1:
+    if not 1 <= conf.federation_batch_limit <= MAX_BATCH_SIZE:
+        # The cap matters: the receiver applies envelopes through the
+        # peer batch handler, which rejects batches over MAX_BATCH_SIZE
+        # — a larger envelope would fail every apply and wedge its
+        # channel in permanent redelivery.
         raise ValueError(
-            f"GUBER_FEDERATION_BATCH_LIMIT must be >= 1; "
-            f"got {conf.federation_batch_limit}"
+            f"GUBER_FEDERATION_BATCH_LIMIT must be in "
+            f"[1, {MAX_BATCH_SIZE}]; got {conf.federation_batch_limit}"
         )
     if conf.federation_timeout <= 0:
         raise ValueError(

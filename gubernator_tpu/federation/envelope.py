@@ -12,7 +12,11 @@ under every WAN failure mode the breaker path produces:
 * **Idempotent** — the receiver keeps the last applied sequence per
   channel (:class:`ReceiveLedger`); a redelivered envelope (``seq <=
   last``) is acked but not re-applied, so a retry after a lost ack (the
-  one-way-partition case) never double-counts.
+  one-way-partition case) never double-counts.  The channel identity is
+  ``(origin, epoch)`` — the sender's advertise address *plus* a
+  per-boot nonce — so a restarted sender (same address, seq back at 1)
+  opens a fresh ledger entry instead of having its first envelopes
+  swallowed as duplicates of the previous incarnation's sequences.
 
 Exactly-once then falls out of the sender discipline in
 :class:`~gubernator_tpu.federation.manager.FederationManager`: at most
@@ -69,8 +73,9 @@ class FederationRecord:
 class FederationEnvelope:
     """A batch of records on one (origin node → target peer) channel."""
 
-    origin: str = ""   # sender's advertise address (the channel identity)
+    origin: str = ""   # sender's advertise address
     region: str = ""   # sender's datacenter (loop-prevention tag)
+    epoch: str = ""    # sender's boot nonce; (origin, epoch) = channel id
     seq: int = 0       # per-channel monotonic sequence, starts at 1
     records: List[FederationRecord] = field(default_factory=list)
 
@@ -85,26 +90,36 @@ class FederationAck:
 
 
 class ReceiveLedger:
-    """Last-applied sequence per origin channel: the idempotency gate.
+    """Last-applied sequence per ``(origin, epoch)`` channel: the
+    idempotency gate.
 
     The sender guarantees at most one outstanding envelope per channel
     and only advances ``seq`` after an ack, so on a healthy channel
     sequences arrive in order; ``seq <= last`` can only mean a
-    redelivery of an envelope whose ack was lost — a no-op."""
+    redelivery of an envelope whose ack was lost — a no-op.
+
+    Keying by epoch (the sender's per-boot nonce) is what makes that
+    inference restart-safe: a rebooted sender reuses its advertise
+    address but numbers a *fresh* stream from 1, and without the epoch
+    every envelope of the new incarnation would compare ``<=`` the old
+    ledger entry and be acked-but-dropped for as long as the previous
+    uptime.  Dead epochs' entries are retained (one int per sender
+    boot) so a straggler redelivery from the previous incarnation is
+    still recognized as a duplicate."""
 
     def __init__(self):
-        self._last: Dict[str, int] = {}
+        self._last: Dict[Tuple[str, str], int] = {}
 
     def seen(self, env: FederationEnvelope) -> bool:
         """True for a duplicate (ack ``seq`` again, apply nothing)."""
-        return env.seq <= self._last.get(env.origin, 0)
+        return env.seq <= self._last.get((env.origin, env.epoch), 0)
 
     def mark(self, env: FederationEnvelope) -> None:
         """Record a successful apply.  Called *after* the apply lands, so
         an apply that fails mid-RPC leaves the sequence unmarked and the
         sender's retry of the same envelope is admitted, not dropped."""
-        self._last[env.origin] = max(
-            env.seq, self._last.get(env.origin, 0))
+        key = (env.origin, env.epoch)
+        self._last[key] = max(env.seq, self._last.get(key, 0))
 
     def admit(self, env: FederationEnvelope) -> bool:
         """Check-and-mark in one step (the unit-fuzz convenience): True
@@ -114,8 +129,8 @@ class ReceiveLedger:
         self.mark(env)
         return True
 
-    def last(self, origin: str) -> int:
-        return self._last.get(origin, 0)
+    def last(self, origin: str, epoch: str = "") -> int:
+        return self._last.get((origin, epoch), 0)
 
 
 def merge_records(

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import secrets
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -50,7 +51,12 @@ from gubernator_tpu.resilience import (
     DecorrelatedJitterBackoff,
     spawn_supervised,
 )
-from gubernator_tpu.types import Behavior, RateLimitRequest, set_behavior
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    Behavior,
+    RateLimitRequest,
+    set_behavior,
+)
 
 log = logging.getLogger("gubernator.federation")
 
@@ -73,6 +79,8 @@ class _Channel:
     inflight: Optional[FederationEnvelope] = None
     inflight_since: float = 0.0
     failing: bool = False           # last send attempt failed
+    sending: bool = False           # an RPC is awaiting right now
+    orphaned: bool = False          # dropped from the ring mid-send
     next_try: float = 0.0
     backoff: DecorrelatedJitterBackoff = field(
         default=None)  # type: ignore[assignment]
@@ -82,7 +90,7 @@ class FederationManager:
     """Owns the inter-region exchange for one V1Instance."""
 
     def __init__(self, instance, metrics=None, clock=time.monotonic,
-                 sleep=asyncio.sleep):
+                 sleep=asyncio.sleep, epoch: str = ""):
         self.instance = instance
         self.metrics = metrics
         self._clock = clock
@@ -93,12 +101,28 @@ class FederationManager:
         self.batch_limit = conf.federation_batch_limit
         self.timeout = conf.federation_timeout
         self.resilience = conf.resilience
+        # Boot nonce: receivers key their ReceiveLedger by (origin,
+        # epoch), so a restart of this node (same advertise address,
+        # seq back at 1) opens a fresh channel instead of having its
+        # envelopes dropped as duplicates of the previous incarnation.
+        self.epoch = epoch or secrets.token_hex(8)
         # region → key → accumulated delta (merge-on-requeue buffer).
         self._pending: Dict[str, Dict[str, FederationRecord]] = {}
         # region → enqueue time of the oldest un-flushed delta.
         self._pending_since: Dict[str, float] = {}
         # target grpc address → channel.
         self._channels: Dict[str, _Channel] = {}
+        # Channels dropped by a ring update while their RPC was still
+        # awaiting: the address is quarantined from _compact until the
+        # RPC settles, so a peer that leaves and instantly rejoins can't
+        # get a second concurrent envelope racing the orphaned one.
+        self._orphans: Dict[str, _Channel] = {}
+        # target grpc address → last assigned seq, retained across
+        # channel drop/recreate (ring churn): the receiver's ledger for
+        # this (origin, epoch) survives the churn, so a recreated
+        # channel to the same address must continue the sequence, not
+        # restart at 1 and be deduplicated into oblivion.
+        self._seqs: Dict[str, int] = {}
         self.ledger = ReceiveLedger()
         # One apply at a time per origin channel: a redelivery racing a
         # still-running slow apply of the same envelope must wait and
@@ -201,11 +225,14 @@ class FederationManager:
             else:
                 groups[addr] = (peer, [key])
         for addr, (peer, keys) in groups.items():
+            if addr in self._orphans:
+                continue  # quarantined until the orphaned RPC settles
             ch = self._channels.get(addr)
             if ch is None:
                 rc = self.resilience
                 ch = self._channels[addr] = _Channel(
                     peer=peer, region=region,
+                    seq=self._seqs.get(addr, 0),
                     backoff=DecorrelatedJitterBackoff(
                         rc.forward_backoff_base, rc.forward_backoff_cap),
                 )
@@ -214,14 +241,17 @@ class FederationManager:
                 continue
             take = keys[: self.batch_limit]
             ch.seq += 1
+            self._seqs[addr] = ch.seq
             ch.inflight = FederationEnvelope(
-                origin=self.origin, region=self.home, seq=ch.seq,
+                origin=self.origin, region=self.home, epoch=self.epoch,
+                seq=ch.seq,
                 records=[pending.pop(k) for k in take],
             )
             ch.inflight_since = self._clock()
 
     async def _send(self, ch: _Channel) -> None:
         env = ch.inflight
+        ch.sending = True
         try:
             ack = await ch.peer.federation_sync(env, timeout=self.timeout)
         except asyncio.CancelledError:
@@ -232,19 +262,94 @@ class FederationManager:
             # retries with the SAME seq after a jittered backoff.  The
             # receiver's ledger makes the retry safe even when only the
             # ack was lost.
-            ch.failing = True
-            ch.next_try = self._clock() + ch.backoff.next()
-            if self.metrics is not None:
-                self.metrics.federation_redeliveries.inc()
+            self._send_failed(ch)
             return
-        if ack.seq >= env.seq:
-            ch.inflight = None
-            ch.inflight_since = 0.0
-            ch.failing = False
-            ch.next_try = 0.0
-            ch.backoff.reset()
-            if self.metrics is not None:
-                self.metrics.federation_envelopes.labels(result="sent").inc()
+        finally:
+            ch.sending = False
+        if ack.seq < env.seq:
+            # A stale ack (buggy or mixed-version receiver) is a failed
+            # delivery, not limbo: without backoff the envelope would
+            # retry every interval with the channel reported healthy.
+            self._send_failed(ch)
+            return
+        ch.inflight = None
+        ch.inflight_since = 0.0
+        ch.failing = False
+        ch.next_try = 0.0
+        ch.backoff.reset()
+        if ch.orphaned:
+            self._release_orphan(ch)
+        if self.metrics is not None:
+            self.metrics.federation_envelopes.labels(result="sent").inc()
+
+    def _send_failed(self, ch: _Channel) -> None:
+        if ch.orphaned:
+            # The target left the ring while this RPC was awaiting; the
+            # channel is already out of the table, so the decision the
+            # reroute deferred lands here: the peer never applied the
+            # envelope, requeue its records for the new owner.
+            self._requeue_inflight(ch)
+            self._release_orphan(ch)
+            return
+        ch.failing = True
+        ch.next_try = self._clock() + ch.backoff.next()
+        if self.metrics is not None:
+            self.metrics.federation_redeliveries.inc()
+
+    def on_ring_update(self) -> None:
+        """Reroute after ``set_peers``: drop channels whose target
+        address left its region's ring, requeueing any in-flight records
+        into the pending buffer so the next compact rehashes them to the
+        new owner.  Without this, an envelope pinned to a departed peer
+        retries that dead address forever — its records never reach the
+        key's new owner, and the channel's failing flag holds
+        :meth:`is_degraded` true and the staleness gauge climbing for
+        a peer that no longer exists."""
+        pickers = self.instance.region_picker.pickers()
+        for addr, ch in list(self._channels.items()):
+            ring = pickers.get(ch.region)
+            if ring is not None and ring.get_by_address(addr) is not None:
+                continue
+            del self._channels[addr]
+            if ch.sending:
+                # An RPC to the departed peer is awaiting right now — it
+                # may yet succeed (graceful drain acks in flight), so
+                # requeueing here could double-deliver.  Defer: _send's
+                # completion either finishes the envelope (delivered,
+                # nothing to do) or requeues on failure; until then the
+                # address is quarantined from _compact.
+                ch.orphaned = True
+                self._orphans[addr] = ch
+                continue
+            self._requeue_inflight(ch)
+
+    def _release_orphan(self, ch: _Channel) -> None:
+        addr = getattr(ch.peer.info, "grpc_address", "")
+        if self._orphans.get(addr) is ch:
+            del self._orphans[addr]
+
+    def _requeue_inflight(self, ch: _Channel) -> None:
+        """Fold a dropped channel's in-flight records back into its
+        region's pending buffer so the next compact rehashes them."""
+        env = ch.inflight
+        requeued_at = ch.inflight_since or self._clock()
+        ch.inflight = None
+        ch.inflight_since = 0.0
+        if env is None or not env.records:
+            return
+        pending = self._pending.setdefault(ch.region, {})
+        since = self._pending_since.get(ch.region)
+        self._pending_since[ch.region] = (
+            requeued_at if since is None else min(since, requeued_at))
+        _, dropped = merge_records(
+            pending, env.records, self.resilience.redelivery_limit)
+        if dropped:
+            log.warning(
+                "federation reroute of %s (left the %s ring) dropped "
+                "%d new-key records: pending buffer full",
+                getattr(ch.peer.info, "grpc_address", "?"), ch.region,
+                dropped,
+            )
 
     def _update_staleness(self) -> None:
         """Export the worst-case cross-region drift age: the oldest delta
@@ -316,12 +421,16 @@ class FederationManager:
                 metadata={FED_ORIGIN_KEY: env.region},
                 created_at=rec.created_at or None,
             ))
-        if reqs:
-            # The owner-relay handler: forces DRAIN_OVER_LIMIT on GLOBAL
-            # hits, applies to the local engine, and queues the intra-
-            # region broadcast — the remote region's hits reach every
-            # local peer through the existing machinery.
-            await self.instance.get_peer_rate_limits(reqs)
+        # The owner-relay handler: forces DRAIN_OVER_LIMIT on GLOBAL
+        # hits, applies to the local engine, and queues the intra-
+        # region broadcast — the remote region's hits reach every
+        # local peer through the existing machinery.  Chunked at
+        # MAX_BATCH_SIZE: the handler rejects larger batches outright,
+        # which would turn an oversized envelope (mixed-version or
+        # misconfigured sender) into a poison message retried forever.
+        for i in range(0, len(reqs), MAX_BATCH_SIZE):
+            await self.instance.get_peer_rate_limits(
+                reqs[i:i + MAX_BATCH_SIZE])
         self.ledger.mark(env)
         if self.metrics is not None:
             self.metrics.federation_envelopes.labels(result="applied").inc()

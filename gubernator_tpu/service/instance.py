@@ -1137,6 +1137,12 @@ class V1Instance:
         self.local_picker, self.region_picker = local, region
         if standalone:
             self._standalone = True
+        if self.federation is not None:
+            # Reroute federation channels whose target peer left its
+            # region's ring: in-flight records requeue to the pending
+            # buffer and rehash to the new remote owner on the next
+            # flush instead of retrying a dead address forever.
+            self.federation.on_ring_update()
 
         # Gracefully drain removed (and replaced) peers.
         doomed = replaced + [

@@ -520,6 +520,7 @@ def encode_federation_envelope(env) -> bytes:
         _struct.pack("<q", env.seq),
         _pack_str(env.origin),
         _pack_str(env.region),
+        _pack_str(env.epoch),
         _struct.pack("<I", len(env.records)),
     ]
     for rec in env.records:
@@ -545,6 +546,7 @@ def parse_federation_envelope(data: bytes):
         off = 12
         origin, off = _unpack_str(data, off)
         region, off = _unpack_str(data, off)
+        epoch, off = _unpack_str(data, off)
         (n,) = _struct.unpack_from("<I", data, off)
         off += 4
         records = []
@@ -559,7 +561,8 @@ def parse_federation_envelope(data: bytes):
                 duration=duration, algorithm=algo, behavior=behavior,
                 burst=burst, created_at=created))
         env = FederationEnvelope(
-            origin=origin, region=region, seq=seq, records=records)
+            origin=origin, region=region, epoch=epoch, seq=seq,
+            records=records)
         return env if off == len(data) else None
     except (_struct.error, IndexError, UnicodeDecodeError):
         return None

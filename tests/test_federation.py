@@ -116,6 +116,31 @@ def test_ledger_failed_apply_admits_retry():
     assert led.last("o:1") == 1
 
 
+def test_ledger_sender_restart_opens_fresh_epoch():
+    """A restarted sender reuses its advertise address but numbers a
+    fresh stream from seq 1 under a new epoch — the ledger must admit
+    it (keying by origin alone would silently drop every envelope of
+    the new incarnation as a 'duplicate' of the old one's sequences),
+    while a straggler redelivery from the dead incarnation still
+    dedups."""
+    led = ReceiveLedger()
+    old = FederationEnvelope(
+        origin="o:1", epoch="boot-1", seq=7, records=[_rec("k", 2)])
+    assert led.admit(old)
+    assert led.last("o:1", "boot-1") == 7
+
+    reborn = FederationEnvelope(
+        origin="o:1", epoch="boot-2", seq=1, records=[_rec("k", 3)])
+    assert not led.seen(reborn)   # NOT a duplicate despite seq 1 <= 7
+    assert led.admit(reborn)
+    assert led.last("o:1", "boot-2") == 1
+
+    # Old-epoch straggler (delayed retry of the dead process): no-op.
+    assert led.seen(old)
+    # And the new epoch keeps its own ordering.
+    assert led.seen(reborn)
+
+
 def test_merge_records_bounds_distinct_keys_not_hits():
     """A full pending buffer drops NEW keys only — tracked keys always
     absorb their delta, so a long partition loses nothing for keys
@@ -139,7 +164,7 @@ def test_federation_wire_roundtrip():
     from gubernator_tpu.transport import fastwire
 
     env = FederationEnvelope(
-        origin="10.0.0.1:81", region="eu", seq=42,
+        origin="10.0.0.1:81", region="eu", epoch="b00t00000001", seq=42,
         records=[
             _rec("k1", 3),
             FederationRecord(name="Ω≈", unique_key="ключ", hits=-2,
@@ -228,6 +253,257 @@ def test_federation_enabled_requires_data_center():
     })
     assert conf.config.federation_enabled
     assert conf.config.federation_interval == 0.25
+
+
+def test_federation_batch_limit_capped_at_peer_batch_size():
+    """A batch limit over MAX_BATCH_SIZE would build envelopes the
+    receiver's peer handler rejects outright — a poison message retried
+    forever — so config load refuses it."""
+    from gubernator_tpu.config import setup_daemon_config
+    from gubernator_tpu.types import MAX_BATCH_SIZE
+
+    with pytest.raises(ValueError, match="GUBER_FEDERATION_BATCH_LIMIT"):
+        setup_daemon_config(environ={
+            "GUBER_FEDERATION_BATCH_LIMIT": str(MAX_BATCH_SIZE + 1),
+        })
+    with pytest.raises(ValueError, match="GUBER_FEDERATION_BATCH_LIMIT"):
+        setup_daemon_config(environ={"GUBER_FEDERATION_BATCH_LIMIT": "0"})
+
+
+# ----------------------------------------------------------------------
+# FederationManager channel discipline (sender-side unit harness)
+# ----------------------------------------------------------------------
+class _FakeRemotePeer:
+    """In-process stand-in for a remote-region PeerClient."""
+
+    def __init__(self, addr, dc="eu"):
+        self.info = PeerInfo(grpc_address=addr, datacenter=dc)
+        self.received = []
+        self.fail = False
+        self.ack_offset = 0   # added to the acked seq (negative = stale)
+
+    async def federation_sync(self, env, timeout=None):
+        from gubernator_tpu.federation.envelope import FederationAck
+
+        if self.fail:
+            raise RuntimeError("wan down")
+        self.received.append(env)
+        return FederationAck(
+            origin=env.origin, seq=env.seq + self.ack_offset,
+            applied=len(env.records))
+
+
+def _region_picker(peers):
+    from gubernator_tpu.parallel.hashring import RegionPicker
+
+    picker = RegionPicker()
+    for p in peers:
+        picker.add(p)
+    return picker
+
+
+def _fake_instance(peers, home="us"):
+    from types import SimpleNamespace
+
+    from gubernator_tpu.resilience import ResilienceConfig
+
+    inst = SimpleNamespace(
+        conf=SimpleNamespace(
+            data_center=home, advertise_address="self:81",
+            federation_interval=60.0, federation_batch_limit=1000,
+            federation_timeout=0.5, resilience=ResilienceConfig()),
+        region_picker=_region_picker(peers),
+        applied=[],
+    )
+
+    async def apply(reqs):
+        inst.applied.append(list(reqs))
+
+    inst.get_peer_rate_limits = apply
+    return inst
+
+
+def _mr_req(key="k", hits=3):
+    return RateLimitRequest(
+        name="fed", unique_key=key, hits=hits, limit=100,
+        duration=60_000)
+
+
+def test_manager_stale_ack_is_a_send_failure():
+    """ack.seq < env.seq (buggy or mixed-version receiver) must count as
+    a failed delivery — backoff, failing flag, degraded — not limbo
+    where the envelope retries every interval on a 'healthy' channel."""
+    from gubernator_tpu.federation.manager import FederationManager
+
+    async def run():
+        peer = _FakeRemotePeer("eu-1:81")
+        inst = _fake_instance([peer])
+        fed = FederationManager(inst, epoch="boot-1")
+        try:
+            peer.ack_offset = -1   # acks seq-1: stale
+            fed.queue(_mr_req())
+            await fed._flush_once(force_retry=True)
+            (ch,) = fed._channels.values()
+            assert ch.failing and ch.inflight is not None
+            assert ch.next_try > 0
+            assert fed.is_degraded()
+            # A correct ack on the retry clears the channel; the retry
+            # carried the SAME envelope (same seq).
+            peer.ack_offset = 0
+            await fed._flush_once(force_retry=True)
+            assert ch.inflight is None and not ch.failing
+            assert [e.seq for e in peer.received] == [1, 1]
+        finally:
+            await fed.close()
+
+    asyncio.run(run())
+
+
+def test_manager_ring_update_reroutes_inflight_to_new_owner():
+    """When the target peer leaves its region's ring mid-flight, the
+    channel is dropped (no zombie failing flag holding is_degraded) and
+    its records requeue and rehash to the new owner — never retried
+    against the dead address forever."""
+    from gubernator_tpu.federation.manager import FederationManager
+
+    async def run():
+        dead = _FakeRemotePeer("eu-1:81")
+        dead.fail = True
+        inst = _fake_instance([dead])
+        fed = FederationManager(inst, epoch="boot-1")
+        try:
+            fed.queue(_mr_req(hits=3))
+            await fed._flush_once(force_retry=True)
+            assert fed.inflight_envelopes() == 1 and fed.is_degraded()
+
+            # Ring churn: the owning peer leaves, an heir joins.
+            heir = _FakeRemotePeer("eu-2:81")
+            inst.region_picker = _region_picker([heir])
+            fed.on_ring_update()
+            assert fed._channels == {}
+            assert not fed.is_degraded()
+            assert fed.pending_keys() == 1
+
+            await fed._flush_once(force_retry=True)
+            assert [(e.seq, len(e.records)) for e in heir.received] \
+                == [(1, 1)]
+            assert heir.received[0].records[0].hits == 3
+            assert fed.pending_keys() == 0
+            assert fed.inflight_envelopes() == 0
+        finally:
+            await fed.close()
+
+    asyncio.run(run())
+
+
+def test_manager_ring_update_mid_send_defers_to_rpc_outcome():
+    """Ring churn while an envelope RPC is awaiting must not decide for
+    the RPC: a send that still succeeds (graceful drain) is delivered —
+    requeueing it would double-count — while a send that fails requeues
+    for the new owner."""
+    from gubernator_tpu.federation.manager import FederationManager
+    from gubernator_tpu.parallel.hashring import RegionPicker
+
+    async def run():
+        for outcome, want_pending in (("ok", 0), ("fail", 1)):
+            gate = asyncio.Event()
+
+            class _SlowPeer(_FakeRemotePeer):
+                async def federation_sync(self, env, timeout=None):
+                    await gate.wait()
+                    if outcome == "fail":
+                        raise RuntimeError("died mid-drain")
+                    return await super().federation_sync(env, timeout)
+
+            peer = _SlowPeer("eu-1:81")
+            inst = _fake_instance([peer])
+            fed = FederationManager(inst, epoch="boot-1")
+            try:
+                fed.queue(_mr_req())
+                task = asyncio.ensure_future(
+                    fed._flush_once(force_retry=True))
+                while not any(
+                        ch.sending for ch in fed._channels.values()):
+                    await asyncio.sleep(0)
+                inst.region_picker = RegionPicker()  # peer leaves
+                fed.on_ring_update()
+                assert fed._channels == {}
+                assert fed.pending_keys() == 0  # decision deferred
+                # While the orphaned RPC is unsettled its address is
+                # quarantined: a rejoin must not open a second channel
+                # racing the in-flight envelope.
+                assert "eu-1:81" in fed._orphans
+                inst.region_picker = _region_picker([peer])
+                fed.queue(_mr_req("other-key"))
+                fed._compact("eu", fed._pending["eu"])
+                assert fed._channels == {}
+                gate.set()
+                await task
+                assert fed._orphans == {}
+                assert fed.pending_keys() == want_pending + 1, outcome
+                assert len(peer.received) == (1 if outcome == "ok" else 0)
+            finally:
+                await fed.close()
+
+    asyncio.run(run())
+
+
+def test_manager_channel_seq_survives_drop_and_recreate():
+    """A peer that leaves and returns gets a channel that CONTINUES the
+    per-address sequence — restarting at 1 would collide with the
+    receiver's (origin, epoch) ledger and every envelope would be
+    deduplicated away."""
+    from gubernator_tpu.federation.manager import FederationManager
+    from gubernator_tpu.parallel.hashring import RegionPicker
+
+    async def run():
+        peer = _FakeRemotePeer("eu-1:81")
+        inst = _fake_instance([peer])
+        fed = FederationManager(inst, epoch="boot-1")
+        try:
+            fed.queue(_mr_req())
+            await fed._flush_once(force_retry=True)
+            assert [e.seq for e in peer.received] == [1]
+
+            inst.region_picker = RegionPicker()   # region vanishes
+            fed.on_ring_update()
+            assert fed._channels == {}
+
+            inst.region_picker = _region_picker([peer])  # ...and returns
+            fed.queue(_mr_req(hits=1))
+            await fed._flush_once(force_retry=True)
+            assert [e.seq for e in peer.received] == [1, 2]
+            assert all(e.epoch == "boot-1" for e in peer.received)
+        finally:
+            await fed.close()
+
+    asyncio.run(run())
+
+
+def test_manager_receive_chunks_oversized_envelope():
+    """An envelope over the peer batch limit (mixed-version or
+    misconfigured sender) applies in chunks instead of becoming a
+    poison message whose apply fails on every redelivery."""
+    from gubernator_tpu.federation.manager import FederationManager
+    from gubernator_tpu.types import MAX_BATCH_SIZE
+
+    async def run():
+        inst = _fake_instance([_FakeRemotePeer("eu-1:81")])
+        fed = FederationManager(inst, epoch="boot-1")
+        try:
+            env = FederationEnvelope(
+                origin="o:1", region="eu", epoch="e1", seq=1,
+                records=[_rec(f"k{i}", 1)
+                         for i in range(MAX_BATCH_SIZE + 5)])
+            ack = await fed.receive(env)
+            assert ack.seq == 1
+            assert ack.applied == MAX_BATCH_SIZE + 5
+            assert [len(b) for b in inst.applied] == [MAX_BATCH_SIZE, 5]
+            assert fed.ledger.seen(env)
+        finally:
+            await fed.close()
+
+    asyncio.run(run())
 
 
 # ----------------------------------------------------------------------
