@@ -2907,6 +2907,237 @@ def child_reshard_live():
     print(json.dumps(out))
 
 
+def child_diurnal_autoscale():
+    """Runs in the subprocess: the closed autoscaling loop
+    (docs/autoscaling.md) replaying a compressed day on a ManualClock —
+    demand ramps up and back down twice (night → morning peak → midday
+    dip → evening peak → night) and the full sample → policy →
+    guardrails → actuate chain drives REAL live reshards (the same
+    freeze → drain → cutover → verify protocol the reshard_live rung
+    exercises) on the 8-device CPU mesh while a driver thread serves
+    continuously.
+
+    The demand SIGNAL is a recorded diurnal trace run through a simple
+    queueing model (p99 ≈ base/(1-utilisation), queue depth = backlog
+    over capacity) so the loop actually closes — an actuation changes
+    capacity, which changes the next sample.  Everything the gates
+    measure is real: every transition is a live engine relayout, state
+    loss comes from the coordinator audit plus an independent key-set
+    sweep, and the transition-window p99 is measured on windows the
+    driver actually served while the coordinator held the lock.
+
+    Exported gates (scripts/check_bench_regression.py):
+
+      autoscale_transitions     committed autonomous transitions — the
+                                rung errors below 2 (a loop that never
+                                acts proves nothing)
+      autoscale_state_loss      rows lost across ALL autonomous
+                                transitions (ABSOLUTE_ZERO)
+      autoscale_flaps           rolling-hour actuation-cap breaches,
+                                computed from the committed actuation
+                                timestamps (ABSOLUTE_ZERO — the flap
+                                suppressor must hold)
+      autoscale_p99_during_transition_ms
+                                p99 of windows served while a
+                                transition held the coordinator lock —
+                                lower-better with slack
+      chip_seconds_saved        ∫(8 − shards(t))dt over the simulated
+                                day vs the static-8-shard baseline —
+                                the headline the controller earns;
+                                HIGHER is better, absolute floor > 0
+    """
+    jax.config.update("jax_platforms", "cpu")
+    import asyncio
+    import threading
+
+    from gubernator_tpu.autoscale import (
+        Autoscaler, AutoscalePolicy, PolicyConfig, SignalSnapshot,
+    )
+    from gubernator_tpu.autoscale.controller import FLAP_WINDOW_S
+    from gubernator_tpu.parallel.mesh_engine import MeshTickEngine, make_mesh
+    from gubernator_tpu.parallel.reshard import ReshardCoordinator
+    from gubernator_tpu.resilience import ManualClock
+    from gubernator_tpu.service.tickloop import TickLoop
+    from gubernator_tpu.types import RateLimitRequest
+
+    n_keys = 1 << 10
+    window = 256
+    rng = np.random.default_rng(23)
+
+    def reqs_for(ids):
+        return [
+            RateLimitRequest(
+                name="bench", unique_key=str(int(k)), hits=1,
+                limit=1_000_000, duration=3_600_000,
+            )
+            for k in ids
+        ]
+
+    eng = MeshTickEngine(
+        mesh=make_mesh(), local_capacity=1 << 9, max_batch=window,
+    )
+    loop = TickLoop(eng, batch_limit=window)
+    coord = ReshardCoordinator(eng, tick_loop=loop, freeze_timeout=60.0,
+                               verify=True)
+    for start in range(0, n_keys, window):
+        loop.submit(reqs_for(range(start, start + window))).result(timeout=120)
+    keys_before = {it["key"] for it in eng.export_items()}
+
+    # -- the compressed day: 96 control windows x 15 simulated minutes.
+    # Demand is "offered windows/s"; each shard serves CAP of them, so
+    # utilisation = demand / (CAP x shards) closes the loop through the
+    # coordinator's real shard count.
+    STEP_S = 900.0
+    N_STEPS = 96
+    CAP = 100.0
+    BASE_MS = 1.0
+
+    def demand_at(i):
+        if i < 16:
+            return 100.0                       # night
+        if i < 32:
+            return 100.0 + 31.25 * (i - 15)    # morning ramp -> 600
+        if i < 48:
+            return 600.0                       # morning peak
+        if i < 60:
+            return 200.0                       # midday dip
+        if i < 68:
+            return 200.0 + 50.0 * (i - 59)     # evening ramp -> 600
+        if i < 76:
+            return 600.0                       # evening peak
+        return 100.0                           # night again
+
+    clock = ManualClock()
+    cur = {"demand": demand_at(0)}
+
+    def sample():
+        shards = int(coord.status()["shards"])
+        util = cur["demand"] / (CAP * shards)
+        return SignalSnapshot(
+            ts=clock(),
+            queue_depth=int(max(0.0, cur["demand"] - CAP * shards) * 2.0),
+            p99_ms=min(50.0, BASE_MS / max(0.02, 1.0 - util)),
+            hot_occupancy=min(1.0, util),
+            shards=shards,
+            reshard_busy=coord.is_busy(),
+        )
+
+    # -- live traffic while the day plays out: every window's latency is
+    # tagged with whether a transition held the lock at any point, so
+    # the rung can report the p99 the clients saw THROUGH the cutovers.
+    lat_busy = []
+    shed = [0]
+    served = [0]
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            ids = rng.integers(0, n_keys, size=window)
+            busy = coord.is_busy()
+            t0 = time.perf_counter()
+            try:
+                out = loop.submit(reqs_for(ids)).result(timeout=120)
+            except Exception:
+                continue
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            busy = busy or coord.is_busy()
+            n_err = sum(1 for r in out if r.error)
+            if n_err:
+                shed[0] += n_err  # retriable freeze sheds, not losses
+                time.sleep(0.005)
+            else:
+                served[0] += 1
+                if busy:
+                    lat_busy.append(dt_ms)
+
+    actuations = []  # (sim_ts, coordinator result dict)
+
+    def exec_reshard(target):
+        res = coord.try_reshard(int(target))
+        actuations.append((clock(), res))
+        time.sleep(0.25)  # serve a beat on the new layout mid-measurement
+        return res
+
+    max_per_hour = 4
+    scaler = Autoscaler(
+        sample, exec_reshard,
+        policy=AutoscalePolicy(PolicyConfig(
+            windows=3, target_p99_ms=5.0, queue_high=100, hysteresis=0.5,
+            occupancy_low=0.3, min_shards=4, max_shards=8,
+        )),
+        interval=STEP_S, cooldown_up=1800.0, cooldown_down=3600.0,
+        max_per_hour=max_per_hour, dry_run=False, ring_size=N_STEPS,
+        clock=clock, sleep=clock.sleep,
+    )
+
+    saved = [0.0]
+    shards_path = [int(coord.status()["shards"])]
+
+    async def day():
+        for i in range(N_STEPS):
+            cur["demand"] = demand_at(i)
+            before = int(coord.status()["shards"])
+            await scaler.step()
+            after = int(coord.status()["shards"])
+            if after != before:
+                shards_path.append(after)
+            # The step's capacity bill: whatever layout served it.
+            saved[0] += (8 - after) * STEP_S
+            clock.advance(STEP_S)
+
+    driver = threading.Thread(target=drive, name="autoscale-driver")
+    driver.start()
+    try:
+        asyncio.run(day())
+    finally:
+        stop.set()
+        driver.join()
+
+    results = [r for _, r in actuations]
+    committed = sum(1 for r in results if r.get("outcome") == "committed")
+    loss = sum(r.get("state_loss", 0) for r in results)
+    # Independent sweep, same as reshard_live: every key resident before
+    # the day must survive every autonomous transition.
+    keys_after = {it["key"] for it in eng.export_items()}
+    loss = max(loss, len(keys_before - keys_after))
+    # Flap breaches: committed actuations in any rolling hour beyond the
+    # cap the guardrail promised — must be 0 if the suppressor works.
+    acts = [t for t, r in actuations if r.get("outcome") == "committed"]
+    flaps = 0
+    for t0 in acts:
+        in_hour = sum(1 for t in acts if 0 <= t - t0 <= FLAP_WINDOW_S)
+        flaps = max(flaps, in_hour - max_per_hour)
+    flaps = max(0, flaps)
+    _, p99 = _pcts(lat_busy) if lat_busy else (0.0, 0.0)
+    vetoes = {}
+    for d in scaler.ring:
+        if d.action == "veto":
+            vetoes[d.reason] = vetoes.get(d.reason, 0) + 1
+    loop.close()
+    out = {
+        "rung": "diurnal_autoscale",
+        "shards_path": "->".join(str(s) for s in shards_path),
+        "autoscale_transitions": committed,
+        "autoscale_state_loss": int(loss),
+        "autoscale_flaps": int(flaps),
+        "autoscale_p99_during_transition_ms": round(p99, 2),
+        "chip_seconds_saved": round(saved[0], 1),
+        "static8_chip_seconds": round(8 * STEP_S * N_STEPS, 1),
+        "autoscale_vetoes": vetoes,
+        "autoscale_shed_retriable": int(shed[0]),
+        "served_windows_during": int(served[0]),
+        "live_items": len(keys_after),
+        "sim_day_s": STEP_S * N_STEPS,
+        "backend": "cpu-8dev",
+    }
+    if committed < 2:
+        out["error"] = (
+            f"expected >= 2 autonomous transitions, got {committed}: "
+            f"{[r.get('outcome') for r in results]}"
+        )
+    print(json.dumps(out))
+
+
 def child_mesh_100m():
     """Runs in the subprocess: the 100M-key multichip rung — the full
     sharded SoA table (8 shards x 12.5M slots, columns layout: 80 B/slot
@@ -3289,6 +3520,14 @@ def rung_reshard_live():
     return _run_child("--child-reshard-live", "reshard_live", timeout=1200)
 
 
+def rung_diurnal_autoscale():
+    # Five-ish autonomous transitions across the compressed day, each a
+    # full live reshard with a fresh shard-set build + warmup on the CPU
+    # venue; budget accordingly.
+    return _run_child("--child-diurnal-autoscale", "diurnal_autoscale",
+                      timeout=1800)
+
+
 def rung_mesh_100m():
     # 8 GB of sharded table + ~8 GB of native slotmaps, populated
     # device-side; the dominant cost is the 100M host key inserts.
@@ -3469,6 +3708,9 @@ def main():
     ladder.append(_safe("mesh_tick_8", rung_mesh_tick))
     ladder.append(_safe("mesh_zipf_8", rung_mesh_zipf))
     ladder.append(_safe("reshard_live", rung_reshard_live))
+    # The closed loop over the same transition machinery: telemetry →
+    # policy → guardrails → live reshard across a compressed day.
+    ladder.append(_safe("diurnal_autoscale", rung_diurnal_autoscale))
     ladder.append(_safe("mesh_100m_multichip", rung_mesh_100m))
     ladder.append(_safe("global_mesh_8", rung_global_mesh))
     ladder.append(_safe("global_sparse_reconcile", rung_global_sparse))
@@ -3671,6 +3913,14 @@ def compact_headline(record, ladder_file):
         # the scalar references is ABSOLUTE_ZERO, and a mixed-policy
         # window must stay ONE device dispatch (ceiling 1.0).
         "mixed_algo_parity_errors", "mixed_algo_dispatches_per_step",
+        # Autoscaler gates (docs/autoscaling.md): zero state loss and
+        # zero flap-cap breaches across the autonomous transitions are
+        # ABSOLUTE_ZERO, the in-transition p99 is lower-better with
+        # slack, and chip_seconds_saved vs the static-8 baseline is the
+        # headline the controller must keep earning (absolute floor).
+        "autoscale_transitions", "autoscale_state_loss",
+        "autoscale_flaps", "autoscale_p99_during_transition_ms",
+        "chip_seconds_saved",
     )
     count_map = {}
     for r in record["ladder"]:
@@ -3696,6 +3946,8 @@ if __name__ == "__main__":
         child_mesh_zipf()
     elif "--child-reshard-live" in sys.argv:
         child_reshard_live()
+    elif "--child-diurnal-autoscale" in sys.argv:
+        child_diurnal_autoscale()
     elif "--child-mesh" in sys.argv:
         child_mesh()
     elif "--child-global-sparse" in sys.argv:

@@ -51,6 +51,20 @@ class AimdLimiter:
         """Current admitted window width, in requests."""
         return self._limit
 
+    def snapshot(self) -> dict:
+        """Cheap public view for the control plane (autoscaler,
+        /debug/autoscaler) — deliberately NOT ``@hot_path``: it runs on
+        the controller's sampling cadence, never inside a tick."""
+        return {
+            "window_limit": self._limit,
+            "enabled": self.enabled,
+            "target_p99_ms": self.target_p99_ms,
+            "max_limit": self.max_limit,
+            "min_limit": self.min_limit,
+            "increases": self.metric_increases,
+            "decreases": self.metric_decreases,
+        }
+
     @property
     def step(self) -> int:
         """Additive increase per adjustment, in requests."""

@@ -69,6 +69,16 @@ class AdmissionQueue:
     def __bool__(self) -> bool:
         return self._requests > 0 or len(self) > 0
 
+    def snapshot(self) -> dict:
+        """Cheap public view for the control plane — not ``@hot_path``
+        (the autoscaler samples it off the tick path; the tick loop's
+        condition serializes access)."""
+        return {
+            "requests": self._requests,
+            "items": len(self),
+            "limit": self.limit,
+        }
+
     @hot_path
     def push(self, item: QueueItem) -> List[QueueItem]:
         """Admit ``item``, shedding queued work to stay under the bound.

@@ -44,6 +44,19 @@ DISCOVERY_TYPES = ("member-list", "etcd", "dns", "k8s", "none")
 # ----------------------------------------------------------------------
 ENV_REGISTRY: Dict[str, str] = {
     "GUBER_ADVERTISE_ADDRESS": "address peers use to reach this node",
+    "GUBER_AUTOSCALE_COOLDOWN_DOWN": "autoscaler: quiet period before a scale-down",
+    "GUBER_AUTOSCALE_COOLDOWN_UP": "autoscaler: quiet period before a scale-up",
+    "GUBER_AUTOSCALE_DRY_RUN": "autoscaler: record decisions without acting",
+    "GUBER_AUTOSCALE_ENABLED": "telemetry-driven shard autoscaler on/off",
+    "GUBER_AUTOSCALE_HYSTERESIS": "autoscaler: scale-down band = target p99 × this",
+    "GUBER_AUTOSCALE_INTERVAL": "autoscaler: signal sampling cadence",
+    "GUBER_AUTOSCALE_MAX_PER_HOUR": "autoscaler: rolling-hour transition cap",
+    "GUBER_AUTOSCALE_MAX_SHARDS": "autoscaler: shard-count ceiling",
+    "GUBER_AUTOSCALE_MIN_SHARDS": "autoscaler: shard-count floor",
+    "GUBER_AUTOSCALE_OCCUPANCY_LOW": "autoscaler: scale-down occupancy threshold",
+    "GUBER_AUTOSCALE_QUEUE_HIGH": "autoscaler: scale-up queue-depth high-water",
+    "GUBER_AUTOSCALE_TARGET_P99_MS": "autoscaler: scale-up window p99 threshold",
+    "GUBER_AUTOSCALE_WINDOWS": "autoscaler: consecutive windows before acting",
     "GUBER_BATCH_LIMIT": "max requests per forwarded peer batch",
     "GUBER_BATCH_TIMEOUT": "deadline for a forwarded peer batch",
     "GUBER_BATCH_WAIT": "batch accumulation window (the tick wait)",
@@ -334,6 +347,28 @@ class Config:
     federation_interval: float = 1.0
     federation_batch_limit: int = 1000
     federation_timeout: float = 1.0
+
+    # Guardrailed shard autoscaler (docs/autoscaling.md): a supervised
+    # controller samples the admission/latency/occupancy telemetry every
+    # autoscale_interval and drives live reshard transitions through
+    # hysteresis bands, per-direction cooldowns, and a rolling-hour flap
+    # cap.  Off by default; when enabled it starts in dry-run (decisions
+    # recorded at /debug/autoscaler, nothing actuated) until
+    # GUBER_AUTOSCALE_DRY_RUN is explicitly turned off.
+    # GUBER_AUTOSCALE_*.
+    autoscale_enabled: bool = False
+    autoscale_interval: float = 10.0
+    autoscale_windows: int = 3
+    autoscale_target_p99_ms: float = 5.0
+    autoscale_queue_high: int = 1000
+    autoscale_hysteresis: float = 0.5
+    autoscale_occupancy_low: float = 0.3
+    autoscale_min_shards: int = 1
+    autoscale_max_shards: int = 8
+    autoscale_cooldown_up: float = 60.0
+    autoscale_cooldown_down: float = 300.0
+    autoscale_max_per_hour: int = 4
+    autoscale_dry_run: bool = True
 
     # Fault-tolerant peer path (docs/resilience.md): per-peer circuit
     # breakers, forward-retry backoff, and the GLOBAL redelivery buffer.
@@ -648,6 +683,24 @@ def setup_daemon_config(
         federation_interval=r.float_seconds("GUBER_FEDERATION_INTERVAL", 1.0),
         federation_batch_limit=r.int_("GUBER_FEDERATION_BATCH_LIMIT", 1000),
         federation_timeout=r.float_seconds("GUBER_FEDERATION_TIMEOUT", 1.0),
+        autoscale_enabled=r.bool_("GUBER_AUTOSCALE_ENABLED"),
+        autoscale_interval=r.float_seconds("GUBER_AUTOSCALE_INTERVAL", 10.0),
+        autoscale_windows=r.int_("GUBER_AUTOSCALE_WINDOWS", 3),
+        autoscale_target_p99_ms=float(
+            r.str_("GUBER_AUTOSCALE_TARGET_P99_MS", "5.0")),
+        autoscale_queue_high=r.int_("GUBER_AUTOSCALE_QUEUE_HIGH", 1000),
+        autoscale_hysteresis=float(
+            r.str_("GUBER_AUTOSCALE_HYSTERESIS", "0.5")),
+        autoscale_occupancy_low=float(
+            r.str_("GUBER_AUTOSCALE_OCCUPANCY_LOW", "0.3")),
+        autoscale_min_shards=r.int_("GUBER_AUTOSCALE_MIN_SHARDS", 1),
+        autoscale_max_shards=r.int_("GUBER_AUTOSCALE_MAX_SHARDS", 8),
+        autoscale_cooldown_up=r.float_seconds(
+            "GUBER_AUTOSCALE_COOLDOWN_UP", 60.0),
+        autoscale_cooldown_down=r.float_seconds(
+            "GUBER_AUTOSCALE_COOLDOWN_DOWN", 300.0),
+        autoscale_max_per_hour=r.int_("GUBER_AUTOSCALE_MAX_PER_HOUR", 4),
+        autoscale_dry_run=r.bool_("GUBER_AUTOSCALE_DRY_RUN", True),
         local_picker_hash=r.str_("GUBER_PEER_PICKER_HASH", "fnv1"),
         replicas=r.int_("GUBER_REPLICATED_HASH_REPLICAS", 512),
         instance_id=r.str_("GUBER_INSTANCE_ID"),
@@ -767,6 +820,60 @@ def setup_daemon_config(
         raise ValueError(
             "GUBER_FEDERATION_ENABLED requires GUBER_DATA_CENTER: regions "
             "are keyed by datacenter name and this node must know its own"
+        )
+    if conf.autoscale_interval <= 0:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_INTERVAL must be > 0; "
+            f"got {conf.autoscale_interval}"
+        )
+    if conf.autoscale_windows < 1:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_WINDOWS must be >= 1; "
+            f"got {conf.autoscale_windows}"
+        )
+    if conf.autoscale_target_p99_ms < 0:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_TARGET_P99_MS must be >= 0 (0 disables the "
+            f"latency signal); got {conf.autoscale_target_p99_ms}"
+        )
+    if conf.autoscale_queue_high < 1:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_QUEUE_HIGH must be >= 1; "
+            f"got {conf.autoscale_queue_high}"
+        )
+    if not 0.0 < conf.autoscale_hysteresis < 1.0:
+        # Strict: hysteresis == 1 would make the scale-down latency band
+        # touch the scale-up band and the controller could ping-pong on
+        # a p99 sitting exactly at target.
+        raise ValueError(
+            f"GUBER_AUTOSCALE_HYSTERESIS must be in (0, 1) so the up and "
+            f"down bands never overlap; got {conf.autoscale_hysteresis}"
+        )
+    if not 0.0 <= conf.autoscale_occupancy_low <= 1.0:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_OCCUPANCY_LOW must be in [0, 1]; "
+            f"got {conf.autoscale_occupancy_low}"
+        )
+    if conf.autoscale_min_shards < 1:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_MIN_SHARDS must be >= 1; "
+            f"got {conf.autoscale_min_shards}"
+        )
+    if conf.autoscale_max_shards < conf.autoscale_min_shards:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_MAX_SHARDS must be >= "
+            f"GUBER_AUTOSCALE_MIN_SHARDS; got "
+            f"{conf.autoscale_max_shards} < {conf.autoscale_min_shards}"
+        )
+    if conf.autoscale_cooldown_up < 0 or conf.autoscale_cooldown_down < 0:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_COOLDOWN_UP/_DOWN must be >= 0; got "
+            f"{conf.autoscale_cooldown_up}/{conf.autoscale_cooldown_down}"
+        )
+    if conf.autoscale_max_per_hour < 1:
+        raise ValueError(
+            f"GUBER_AUTOSCALE_MAX_PER_HOUR must be >= 1; "
+            f"got {conf.autoscale_max_per_hour}"
         )
     if not 0.0 < resilience.breaker_failure_threshold <= 1.0:
         raise ValueError(

@@ -129,6 +129,11 @@ class FederationManager:
         # then read the marked ledger (duplicate), not start a second
         # apply off the not-yet-marked one.
         self._apply_locks: Dict[str, asyncio.Lock] = {}
+        # Reshard interlock: while True, no envelope is compacted or
+        # sent (the ReshardCoordinator pauses sends for FREEZE→CUTOVER
+        # so no envelope snapshots half-relayouted owner state; deltas
+        # keep accumulating in _pending and flush after resume()).
+        self._paused = False
         self._running = True
         self._task = spawn_supervised(
             self._flush_loop, name="federation-flush",
@@ -190,9 +195,27 @@ class FederationManager:
             await self._flush_once()
             self._update_staleness()
 
+    def pause(self) -> None:
+        """Stop compacting/sending envelopes (reshard FREEZE→CUTOVER).
+        Queued deltas keep merging into ``_pending``; nothing is lost.
+        Called from the coordinator's executor thread — a plain bool
+        flip read by the flush loop is the whole protocol (same shape
+        as MeshGlobalEngine.pause_reconcile)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        """Re-enable envelope flushes after reshard commit/abort; the
+        next flush tick drains everything accumulated under the pause."""
+        self._paused = False
+
     async def _flush_once(self, force_retry: bool = False) -> None:
         """Compact pending deltas into envelopes on idle channels, then
-        send every due envelope concurrently."""
+        send every due envelope concurrently.  A pause() (reshard
+        cutover in flight) gates the whole flush — including the
+        force_retry final flush, which is safe because the coordinator's
+        finally block always resumes before the instance closes."""
+        if self._paused:
+            return
         for region, pending in self._pending.items():
             if not pending:
                 continue

@@ -73,6 +73,17 @@ class ReshardError(RuntimeError):
     """A transition could not start (already running / bad target)."""
 
 
+# The single source of truth for the concurrent-call outcome: every
+# caller — Instance.reshard(), the /debug/reshard 409, the autoscaler's
+# reshard_busy veto — consumes this one dict instead of string-matching
+# a ReshardError.  The coordinator's non-blocking lock is the only busy
+# check anywhere; two callers can never race into a double-freeze.
+BUSY_RESULT = {
+    "result": "busy",
+    "error": "a reshard transition is already running",
+}
+
+
 class ReshardCoordinator:
     """Drives one transition at a time over an engine + tick loop.
 
@@ -89,6 +100,10 @@ class ReshardCoordinator:
     * ``global_engine`` — a :class:`MeshGlobalEngine` whose reconcile
       cadence is paused for the cutover window (collectives must not
       contend with the relayout dispatch on the same devices).
+    * ``federation`` — a :class:`FederationManager` whose envelope
+      flushes are paused for FREEZE→CUTOVER and resumed after
+      commit/abort: an envelope compacted mid-relayout would snapshot
+      half-moved owner state and export it to every remote region.
     * ``metrics`` — the daemon's :class:`Metrics` registry.
     """
 
@@ -99,6 +114,7 @@ class ReshardCoordinator:
         transition_log=None,
         breaker_check: Optional[Callable[[], bool]] = None,
         global_engine=None,
+        federation=None,
         metrics=None,
         freeze_timeout: float = 5.0,
         verify: bool = True,
@@ -108,6 +124,7 @@ class ReshardCoordinator:
         self.transition_log = transition_log
         self.breaker_check = breaker_check
         self.global_engine = global_engine
+        self.federation = federation
         self.metrics = metrics
         self.freeze_timeout = float(freeze_timeout)
         self.verify = bool(verify)
@@ -149,21 +166,37 @@ class ReshardCoordinator:
     # ------------------------------------------------------------------
     # The transition
     # ------------------------------------------------------------------
-    def reshard(self, new_shards: int) -> dict:
-        """Run one n→m transition to completion; returns the outcome
-        dict (also kept as ``self.last``).  Raises :class:`ReshardError`
-        when a transition is already running or the target is invalid;
-        never raises on an *aborted* transition — abort is a defined
-        outcome, not an error."""
+    def is_busy(self) -> bool:
+        """True while a transition holds the coordinator lock.  Advisory
+        only (the lock may flip between check and call) — callers that
+        must not block use :meth:`try_reshard`, whose non-blocking
+        acquire is the authoritative check."""
+        return self._lock.locked()
+
+    def try_reshard(self, new_shards: int) -> dict:
+        """Run one n→m transition, or return ``BUSY_RESULT`` (a copy)
+        when one is already running — the non-raising entry point the
+        autoscaler and admin endpoint share, so neither can double-freeze
+        the other.  Still raises :class:`ReshardError` for an invalid
+        target; never raises on an *aborted* transition — abort is a
+        defined outcome, not an error."""
         new_n = int(new_shards)
         if new_n < 1:
             raise ReshardError(f"target shard count must be >= 1: {new_n}")
         if not self._lock.acquire(blocking=False):
-            raise ReshardError("a reshard transition is already running")
+            return dict(BUSY_RESULT)
         try:
             return self._run(new_n)
         finally:
             self._lock.release()
+
+    def reshard(self, new_shards: int) -> dict:
+        """Raising wrapper over :meth:`try_reshard` (the original API):
+        a concurrent transition surfaces as :class:`ReshardError`."""
+        out = self.try_reshard(new_shards)
+        if out.get("result") == "busy":
+            raise ReshardError(out["error"])
+        return out
 
     def _run(self, new_n: int) -> dict:
         from_n = int(getattr(self.engine, "n_shards", 1))
@@ -189,6 +222,10 @@ class ReshardCoordinator:
                 self.tick_loop.freeze()
             if self.global_engine is not None:
                 self.global_engine.pause_reconcile()
+            if self.federation is not None:
+                # No envelope may be compacted from half-relayouted
+                # owner state; resumed in the finally below.
+                self.federation.pause()
             # DRAIN: bounded quiesce — cutover never runs under traffic.
             self._set_phase(PHASE_DRAIN)
             if self.tick_loop is not None:
@@ -242,6 +279,8 @@ class ReshardCoordinator:
         finally:
             if self.global_engine is not None:
                 self.global_engine.resume_reconcile()
+            if self.federation is not None:
+                self.federation.resume()
             if self.tick_loop is not None:
                 self.tick_loop.unfreeze()
             self._set_phase(

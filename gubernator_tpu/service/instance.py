@@ -130,6 +130,22 @@ class InstanceConfig:
     federation_interval: float = 1.0
     federation_batch_limit: int = 1000
     federation_timeout: float = 1.0
+    # Guardrailed shard autoscaler (docs/autoscaling.md): closes the
+    # telemetry → reshard loop.  Off by default; dry-run by default
+    # when on (decisions recorded, nothing actuated).
+    autoscale_enabled: bool = False
+    autoscale_interval: float = 10.0
+    autoscale_windows: int = 3
+    autoscale_target_p99_ms: float = 5.0
+    autoscale_queue_high: int = 1000
+    autoscale_hysteresis: float = 0.5
+    autoscale_occupancy_low: float = 0.3
+    autoscale_min_shards: int = 1
+    autoscale_max_shards: int = 8
+    autoscale_cooldown_up: float = 60.0
+    autoscale_cooldown_down: float = 300.0
+    autoscale_max_per_hour: int = 4
+    autoscale_dry_run: bool = True
 
     @classmethod
     def from_config(cls, conf: Config, advertise_address: str = "", **kw):
@@ -169,6 +185,19 @@ class InstanceConfig:
             federation_interval=conf.federation_interval,
             federation_batch_limit=conf.federation_batch_limit,
             federation_timeout=conf.federation_timeout,
+            autoscale_enabled=conf.autoscale_enabled,
+            autoscale_interval=conf.autoscale_interval,
+            autoscale_windows=conf.autoscale_windows,
+            autoscale_target_p99_ms=conf.autoscale_target_p99_ms,
+            autoscale_queue_high=conf.autoscale_queue_high,
+            autoscale_hysteresis=conf.autoscale_hysteresis,
+            autoscale_occupancy_low=conf.autoscale_occupancy_low,
+            autoscale_min_shards=conf.autoscale_min_shards,
+            autoscale_max_shards=conf.autoscale_max_shards,
+            autoscale_cooldown_up=conf.autoscale_cooldown_up,
+            autoscale_cooldown_down=conf.autoscale_cooldown_down,
+            autoscale_max_per_hour=conf.autoscale_max_per_hour,
+            autoscale_dry_run=conf.autoscale_dry_run,
             **kw,
         )
 
@@ -387,10 +416,19 @@ class V1Instance:
             breaker_check=lambda: any(
                 p.breaker.is_open() for p in self.get_peer_list()),
             global_engine=self.global_mesh,
+            # Reshard × federation interlock (docs/federation.md): no
+            # envelope may be compacted from half-relayouted owner
+            # state — sends pause for FREEZE→CUTOVER.
+            federation=self.federation,
             metrics=self.metrics,
             freeze_timeout=conf.reshard_freeze_timeout,
             verify=conf.reshard_verify,
         )
+        # Guardrailed shard autoscaler (docs/autoscaling.md): closes the
+        # telemetry → reshard loop.  Constructed and started by
+        # create() when enabled (spawn_supervised needs a running event
+        # loop); None otherwise so /debug/autoscaler can answer 404.
+        self.autoscaler = None
         # Crash-safe persistence (docs/persistence.md): wired by create().
         self._snapshot_writer = None
         self.restore_stats: dict = {}
@@ -422,6 +460,8 @@ class V1Instance:
         rec = check_interrupted(inst.reshard_coord.transition_log)
         if rec is not None:
             inst.reshard_coord.record_interrupted(rec)
+        if conf.autoscale_enabled:
+            inst._start_autoscaler()
         return inst
 
     async def _start_persistence(self) -> None:
@@ -1029,15 +1069,21 @@ class V1Instance:
     # ------------------------------------------------------------------
     async def reshard(self, new_shards: int) -> dict:
         """Run one n→m transition (admin-triggered via POST
-        /debug/reshard).  The coordinator's freeze/drain/cutover is
-        blocking device + lock work, so it runs in a worker thread; the
-        event loop keeps serving the shed-with-retriable answers the
-        freeze produces.  After a committed transition, tracked GLOBAL
+        /debug/reshard, or the autoscaler).  The coordinator's
+        freeze/drain/cutover is blocking device + lock work, so it runs
+        in a worker thread; the event loop keeps serving the
+        shed-with-retriable answers the freeze produces.  A concurrent
+        transition returns the coordinator's ``{"result": "busy"}``
+        dict — the coordinator lock is the single busy source of truth,
+        so the autoscaler and the admin endpoint can never race into a
+        double-freeze.  After a committed transition, tracked GLOBAL
         keys re-broadcast through the PR 4 ownership-handoff path so
         any peer holding pre-transition state converges."""
         result = await asyncio.get_running_loop().run_in_executor(
-            None, self.reshard_coord.reshard, int(new_shards)
+            None, self.reshard_coord.try_reshard, int(new_shards)
         )
+        if result.get("result") == "busy":
+            return result
         if result.get("outcome") == "committed" and self.global_mgr._owned:
             t = asyncio.get_running_loop().create_task(
                 self.global_mgr.transfer_ownership(),
@@ -1050,6 +1096,46 @@ class V1Instance:
     def reshard_status(self) -> dict:
         """Coordinator phase/outcome snapshot for /debug/state."""
         return self.reshard_coord.status()
+
+    def _start_autoscaler(self) -> None:
+        """Construct and start the guardrailed autoscaler
+        (docs/autoscaling.md) over this instance's telemetry and
+        :meth:`reshard`.  Requires a running event loop (called from
+        :meth:`create`); :meth:`close` stops it first."""
+        from gubernator_tpu.autoscale import (
+            Autoscaler,
+            AutoscalePolicy,
+            PolicyConfig,
+            instance_sampler,
+        )
+
+        conf = self.conf
+        policy = AutoscalePolicy(PolicyConfig(
+            windows=conf.autoscale_windows,
+            target_p99_ms=conf.autoscale_target_p99_ms,
+            queue_high=conf.autoscale_queue_high,
+            hysteresis=conf.autoscale_hysteresis,
+            occupancy_low=conf.autoscale_occupancy_low,
+            min_shards=conf.autoscale_min_shards,
+            max_shards=conf.autoscale_max_shards,
+        ))
+        self.autoscaler = Autoscaler(
+            instance_sampler(self, time.monotonic),
+            self.reshard,
+            policy=policy,
+            interval=conf.autoscale_interval,
+            cooldown_up=conf.autoscale_cooldown_up,
+            cooldown_down=conf.autoscale_cooldown_down,
+            max_per_hour=conf.autoscale_max_per_hour,
+            dry_run=conf.autoscale_dry_run,
+            metrics=self.metrics,
+        )
+        self.autoscaler.start()
+        self.log.info(
+            "autoscaler started (interval=%.1fs, dry_run=%s, shards "
+            "[%d, %d])", conf.autoscale_interval, conf.autoscale_dry_run,
+            conf.autoscale_min_shards, conf.autoscale_max_shards,
+        )
 
     # ------------------------------------------------------------------
     # Health / peers
@@ -1231,6 +1317,10 @@ class V1Instance:
         if self._closed:
             return
         self._closed = True
+        if self.autoscaler is not None:
+            # First out: the controller must not start a transition
+            # against an instance that is tearing down.
+            await self.autoscaler.stop()
         # Pending ownership transfers need peers and the tick loop alive.
         if self._transfer_tasks:
             await asyncio.gather(

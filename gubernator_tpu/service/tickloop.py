@@ -189,6 +189,21 @@ class TickLoop:
             self.batch_limit,
         )
 
+    def admission_snapshot(self) -> dict:
+        """One consistent view of the admission plane for the control
+        plane (autoscaler, /debug/autoscaler): limiter state, queue
+        depth, cumulative shed counts, freeze level.  Takes the loop
+        condition briefly; not ``@hot_path`` — it runs on the
+        controller's sampling cadence, never inside a tick."""
+        with self._cond:
+            return {
+                "limiter": self.limiter.snapshot(),
+                "queue": self._queue.snapshot(),
+                "pending": self._pending_count,
+                "shed": dict(self.metric_shed_admission),
+                "frozen": self._freeze_level > 0,
+            }
+
     def submit_columns(self, cols, deadline: float = None,
                        klass: int = CLASS_CLIENT) -> "Future":
         """Queue a columnar batch; the future resolves to the

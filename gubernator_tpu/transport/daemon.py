@@ -575,6 +575,7 @@ class Daemon:
         app.router.add_get("/debug/state", self._h_debug_state)
         app.router.add_get("/debug/profile", self._h_debug_profile)
         app.router.add_post("/debug/reshard", self._h_debug_reshard)
+        app.router.add_get("/debug/autoscaler", self._h_debug_autoscaler)
 
     async def _start_gateway(self) -> None:
         if not self.conf.http_listen_address:
@@ -833,14 +834,34 @@ class Daemon:
                 "write_failures": writer.metric_write_failures,
             }
         body["reshard"] = inst.reshard_status()
+        if inst.autoscaler is not None:
+            scaler_state = inst.autoscaler.debug_state()
+            scaler_state.pop("decisions", None)  # the ring lives at
+            body["autoscaler"] = scaler_state    # /debug/autoscaler
         return web.json_response(body)
+
+    async def _h_debug_autoscaler(self, request: web.Request) -> web.Response:
+        """Autoscaler introspection (docs/autoscaling.md): config,
+        streaks, and the bounded decision ring — the dry-run rollout
+        reads this until the decisions look right."""
+        if self.instance is None:
+            return web.json_response({"error": "starting up"}, status=503)
+        scaler = self.instance.autoscaler
+        if scaler is None:
+            return web.json_response(
+                {"error": "autoscaler disabled (GUBER_AUTOSCALE_ENABLED)"},
+                status=404,
+            )
+        return web.json_response(scaler.debug_state())
 
     async def _h_debug_reshard(self, request: web.Request) -> web.Response:
         """Admin trigger (docs/resharding.md): POST {"shards": m} runs
         one n→m transition and answers its outcome dict.  409 when a
-        transition is already running; 400 on a bad target.  The debug
-        plane is operator-only (GUBER_DEBUG_ENDPOINTS), same trust level
-        as /debug/profile."""
+        transition is already running (the coordinator's busy dict is
+        the single source of truth — the autoscaler consults the same
+        lock, so the two can never double-freeze); 400 on a bad target.
+        The debug plane is operator-only (GUBER_DEBUG_ENDPOINTS), same
+        trust level as /debug/profile."""
         if self.instance is None:
             return web.json_response({"error": "starting up"}, status=503)
         try:
@@ -856,9 +877,9 @@ class Daemon:
         try:
             result = await self.instance.reshard(shards)
         except ReshardError as e:
-            busy = "already running" in str(e)
-            return web.json_response(
-                {"error": str(e)}, status=409 if busy else 400)
+            return web.json_response({"error": str(e)}, status=400)
+        if result.get("result") == "busy":
+            return web.json_response(result, status=409)
         return web.json_response(result)
 
     async def _h_debug_profile(self, request: web.Request) -> web.Response:

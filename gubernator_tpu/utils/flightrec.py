@@ -198,6 +198,42 @@ class FlightRecorder:
                 }
         return out
 
+    def snapshot(self) -> dict:
+        """Per-stage and whole-window p50/p99 (ms) plus ring metadata —
+        the control plane's view (autoscaler, /debug/autoscaler).  Not
+        ``@hot_path``: one lock-copy on the controller's cadence."""
+        with self._lock:
+            mask = self._valid.copy()
+            stage_s = self._stage_s.copy()
+            slow_total = self.slow_total
+        totals = stage_s[mask].sum(axis=1)
+        totals = totals[totals > 0.0]
+        if totals.size == 0:
+            total = {"p50_ms": 0.0, "p99_ms": 0.0}
+        else:
+            total = {
+                "p50_ms": round(float(np.percentile(totals, 50)) * 1e3, 4),
+                "p99_ms": round(float(np.percentile(totals, 99)) * 1e3, 4),
+            }
+        out: Dict[str, Dict[str, float]] = {}
+        for s, i in _IDX.items():
+            col = stage_s[mask, i]
+            col = col[col > 0.0]
+            if col.size == 0:
+                out[s] = {"p50_ms": 0.0, "p99_ms": 0.0}
+            else:
+                out[s] = {
+                    "p50_ms": round(float(np.percentile(col, 50)) * 1e3, 4),
+                    "p99_ms": round(float(np.percentile(col, 99)) * 1e3, 4),
+                }
+        return {
+            "stages": out,
+            "total": total,
+            "windows": int(mask.sum()),
+            "ring_size": self.windows,
+            "slow_total": slow_total,
+        }
+
     def drain_slow(self) -> List[dict]:
         """Pop pending slow-window dumps (watchdog loop calls this)."""
         out: List[dict] = []

@@ -647,6 +647,35 @@ class Metrics:
             "to the staleness budget.",
             registry=reg,
         )
+        # Guardrailed shard autoscaler (docs/autoscaling.md): every
+        # control decision, every actuated transition, and every
+        # guardrail veto by name — the outside view of the controller.
+        self.autoscale_decisions = Counter(
+            "gubernator_tpu_autoscale_decisions",
+            "Autoscaler control decisions by action: \"act\" (a "
+            "transition was actuated, or would have been in dry-run), "
+            "\"hold\" (no sustained pressure / already at a bound), "
+            "\"veto\" (a guardrail blocked an otherwise-justified "
+            "transition).",
+            ["action"],
+            registry=reg,
+        )
+        self.autoscale_transitions = Counter(
+            "gubernator_tpu_autoscale_transitions",
+            "Committed shard transitions actuated by the autoscaler, by "
+            "direction (\"up\"/\"down\"); dry-run decisions and aborted "
+            "transitions are not counted here.",
+            ["direction"],
+            registry=reg,
+        )
+        self.autoscale_vetoes = Counter(
+            "gubernator_tpu_autoscale_vetoes",
+            "Autoscaler decisions blocked by a guardrail, by reason: "
+            "breaker_open, reshard_busy, cooldown_up, cooldown_down, "
+            "flap_cap, reshard_error.",
+            ["reason"],
+            registry=reg,
+        )
         self.loop_restarts = Counter(
             "gubernator_loop_restarts",
             "Background loops (global_hits, global_broadcast, peer_batch) "

@@ -119,6 +119,17 @@ RATE_KEYS = ("decisions_per_sec", "requests_per_sec")
 #                                        isolated region admits at most
 #                                        one limit's worth, so a 2-region
 #                                        split caps the extra at 1.0x
+#   autoscale_state_loss           0   — the diurnal_autoscale rung's
+#                                        AUTONOMOUS transitions (policy-
+#                                        driven, not operator-driven)
+#                                        keep every live bucket, same
+#                                        sweep as reshard_state_loss
+#                                        (docs/autoscaling.md)
+#   autoscale_flaps                0   — committed actuations in any
+#                                        rolling hour never exceed the
+#                                        flap suppressor's cap; a breach
+#                                        means the guardrail chain let
+#                                        the controller react to noise
 COUNT_KEYS = (
     "dispatches_per_step",
     "churn_continuity_errors",
@@ -150,6 +161,8 @@ COUNT_KEYS = (
     "mixed_algo_dispatches_per_step",
     "federation_hit_loss_after_heal",
     "federation_over_admission_ratio",
+    "autoscale_state_loss",
+    "autoscale_flaps",
 )
 
 # Serving-path perf keys (PR 6's zero-copy/pipelined serving path).
@@ -183,11 +196,17 @@ COUNT_KEYS = (
 #                           lower is better, 1.5x slack (tail noise); a
 #                           blowup means the freeze/cutover window
 #                           stopped being bounded (docs/resharding.md)
+#   autoscale_p99_during_transition_ms  the same bound over the
+#                           diurnal_autoscale rung's AUTONOMOUS
+#                           transitions — lower is better, 1.5x slack;
+#                           the controller must not make the freeze
+#                           window worse than an operator-driven one
 LOWER_BETTER_SLACK = {
     "serve_cpu_ms_per_batch": 1.3,
     "loopback_p99_ms": 1.5,
     "overload_admitted_p99_ms": 1.5,
     "reshard_p99_during_ms": 1.5,
+    "autoscale_p99_during_transition_ms": 1.5,
     "stage_decode_p99_ms": 1.5,
     "stage_pack_p99_ms": 1.5,
     "stage_h2d_p99_ms": 1.5,
@@ -215,11 +234,19 @@ LOWER_BETTER_SLACK = {
 #                           HIGHER is better, candidate keeps >= 0.9x
 #                           the baseline, and the >=10x absolute floor
 #                           below holds regardless
+#   chip_seconds_saved      ∫(8 − shards(t))dt over the diurnal rung's
+#                           simulated day vs an always-8-shard static
+#                           deployment — the autoscaler's headline
+#                           (docs/autoscaling.md); HIGHER is better,
+#                           candidate keeps >= 0.9x the baseline, and
+#                           the absolute floor below demands it stay
+#                           positive regardless
 HIGHER_BETTER_FLOOR = {
     "h2d_overlap_ratio": 0.9,
     "mesh_scaling_efficiency": 0.9,
     "overload_goodput_ratio": 0.9,
     "lease_traffic_reduction": 0.9,
+    "chip_seconds_saved": 0.9,
 }
 # ...and, baseline or not, a pipelined dispatch that stops overlapping
 # at all is a regression in its own right: absolute floor on the
@@ -235,6 +262,10 @@ ABSOLUTE_MIN_KEYS = {
     # tier must cut server-served traffic by at least an order of
     # magnitude on the steady-state admission stream.
     "lease_traffic_reduction": 10.0,
+    # An autoscaler that never gives capacity back is a static
+    # deployment with extra steps: the diurnal day must bank SOME
+    # chip-seconds vs always-8-shards, baseline or not.
+    "chip_seconds_saved": 1.0,
 }
 # Absolute ceilings on the candidate, the MIN keys' mirror: telemetry
 # must stay effectively free (≤5% serving-rate cost with the flight
@@ -303,6 +334,8 @@ ABSOLUTE_ZERO_KEYS = (
     "multiproc_dropped_acked",
     "mixed_algo_parity_errors",
     "federation_hit_loss_after_heal",
+    "autoscale_state_loss",
+    "autoscale_flaps",
 )
 
 

@@ -1,0 +1,358 @@
+"""Autoscaler controller tests (docs/autoscaling.md).
+
+Everything here runs on a :class:`ManualClock` with a fake reshard
+executor — no engine builds, no real sleeps.  What these pin is the
+guardrail contract: N sustained windows before any action, hysteresis
+bands that cannot ping-pong, cooldown / flap-cap / breaker / busy
+vetoes counted by reason, dry-run never actuating, and the bounded
+decision ring wrapping instead of growing.
+"""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    PolicyConfig,
+    SignalSnapshot,
+)
+from gubernator_tpu.autoscale.controller import ACT, HOLD, VETO
+from gubernator_tpu.autoscale.policy import DOWN, UP
+from gubernator_tpu.resilience import ManualClock
+from gubernator_tpu.utils.metrics import Metrics
+
+
+def _snap(p99=1.0, queue=0, occ=0.5, shards=2, **kw):
+    return SignalSnapshot(
+        p99_ms=p99, queue_depth=queue, hot_occupancy=occ, shards=shards,
+        **kw,
+    )
+
+
+class _Feed:
+    """Scripted sampler: pops queued snapshots, repeats the last one."""
+
+    def __init__(self, *snaps):
+        self.snaps = list(snaps)
+
+    def script(self, *snaps):
+        """Replace the remaining script (takes effect next sample)."""
+        self.snaps = list(snaps)
+
+    def __call__(self):
+        if len(self.snaps) > 1:
+            return self.snaps.pop(0)
+        return self.snaps[0]
+
+
+class _FakeReshard:
+    """Executor double: records targets, scripts outcomes."""
+
+    def __init__(self, outcome="committed"):
+        self.calls = []
+        self.outcome = outcome
+
+    def __call__(self, target):
+        self.calls.append(target)
+        if self.outcome == "busy":
+            return {"result": "busy"}
+        if self.outcome == "raise":
+            raise RuntimeError("engine exploded")
+        return {"outcome": self.outcome, "from_shards": 0,
+                "to_shards": target}
+
+
+def _scaler(feed, reshard, *, windows=3, dry_run=False, clock=None, **kw):
+    clock = clock or ManualClock()
+    policy = AutoscalePolicy(PolicyConfig(
+        windows=windows, target_p99_ms=5.0, queue_high=100,
+        hysteresis=0.5, occupancy_low=0.3, min_shards=1, max_shards=8,
+    ))
+    scaler = Autoscaler(
+        feed, reshard, policy=policy, dry_run=dry_run,
+        clock=clock, sleep=clock.sleep, **kw,
+    )
+    return scaler, clock
+
+
+async def _steps(scaler, clock, n, dt=10.0):
+    out = []
+    for _ in range(n):
+        clock.advance(dt)
+        out.append(await scaler.step())
+    return out
+
+
+def test_single_spike_holds_sustained_pressure_acts():
+    """One hot window is noise; N consecutive hot windows are load."""
+
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0), _snap(), _snap())
+        scaler, clock = _scaler(feed, rs, windows=3)
+        # spike, then calm: streak resets, nothing actuates
+        d = await _steps(scaler, clock, 3)
+        assert [x.action for x in d] == [HOLD, HOLD, HOLD]
+        assert rs.calls == []
+        # sustained: 3 consecutive hot windows → scale up 2 → 4
+        feed.script(_snap(p99=50.0))
+        d = await _steps(scaler, clock, 3)
+        assert [x.action for x in d] == [HOLD, HOLD, ACT]
+        assert d[-1].direction == UP and d[-1].to_shards == 4
+        assert rs.calls == [4]
+
+    asyncio.run(run())
+
+
+def test_queue_depth_alone_triggers_scale_up():
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(queue=500))
+        scaler, clock = _scaler(feed, rs, windows=2)
+        d = await _steps(scaler, clock, 2)
+        assert d[-1].action == ACT and d[-1].direction == UP
+        assert rs.calls == [4]
+
+    asyncio.run(run())
+
+
+def test_hysteresis_band_prevents_ping_pong():
+    """A p99 between target × hysteresis and target satisfies neither
+    band: after a scale-up driven by p99 > 5, a p99 of 4 (under target,
+    over the 2.5 down-band) with low occupancy must hold forever — the
+    classic ping-pong input."""
+
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0))
+        scaler, clock = _scaler(feed, rs, windows=2, cooldown_up=0.0,
+                                cooldown_down=0.0)
+        await _steps(scaler, clock, 2)
+        assert rs.calls == [4]
+        # in-band: under target (no up), over target×hysteresis (no down)
+        feed.script(_snap(p99=4.0, occ=0.05, shards=4))
+        d = await _steps(scaler, clock, 20)
+        assert all(x.action == HOLD for x in d)
+        assert rs.calls == [4]  # no reversal, ever
+        # genuinely idle (p99 under the down band too) → scale down
+        feed.script(_snap(p99=1.0, occ=0.05, shards=4))
+        d = await _steps(scaler, clock, 2)
+        assert d[-1].action == ACT and d[-1].direction == DOWN
+        assert rs.calls == [4, 2]
+
+    asyncio.run(run())
+
+
+def test_cooldown_vetoes_counted_then_expire():
+    async def run():
+        m = Metrics()
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0))
+        scaler, clock = _scaler(feed, rs, windows=1, metrics=m,
+                                cooldown_up=120.0)
+        d = await _steps(scaler, clock, 1)
+        assert d[0].action == ACT and rs.calls == [4]
+        # inside the 120 s up-cooldown (10 s steps): vetoed by name
+        d = await _steps(scaler, clock, 3)
+        assert [x.reason for x in d] == ["cooldown_up"] * 3
+        assert m.sample("gubernator_tpu_autoscale_vetoes_total",
+                        {"reason": "cooldown_up"}) == 3
+        # past the cooldown the sustained pressure acts again
+        clock.advance(120.0)
+        d = await _steps(scaler, clock, 1)
+        assert d[0].action == ACT
+        assert rs.calls == [4, 4]
+
+    asyncio.run(run())
+
+
+def test_flap_cap_bounds_transitions_per_rolling_hour():
+    async def run():
+        m = Metrics()
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0))
+        scaler, clock = _scaler(feed, rs, windows=1, metrics=m,
+                                cooldown_up=0.0, max_per_hour=2)
+        d = await _steps(scaler, clock, 5)
+        acts = [x for x in d if x.action == ACT]
+        vetoes = [x for x in d if x.action == VETO]
+        assert len(acts) == 2 and len(rs.calls) == 2
+        assert all(x.reason == "flap_cap" for x in vetoes)
+        assert m.sample("gubernator_tpu_autoscale_vetoes_total",
+                        {"reason": "flap_cap"}) == 3
+        # an hour later the budget refills
+        clock.advance(3600.0)
+        d = await _steps(scaler, clock, 1)
+        assert d[0].action == ACT and len(rs.calls) == 3
+
+    asyncio.run(run())
+
+
+def test_open_breaker_vetoes_actuation():
+    async def run():
+        m = Metrics()
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0, breaker_open=True))
+        scaler, clock = _scaler(feed, rs, windows=1, metrics=m)
+        d = await _steps(scaler, clock, 3)
+        assert all(x.action == VETO and x.reason == "breaker_open"
+                   for x in d)
+        assert rs.calls == []
+        assert m.sample("gubernator_tpu_autoscale_vetoes_total",
+                        {"reason": "breaker_open"}) == 3
+
+    asyncio.run(run())
+
+
+def test_reshard_busy_vetoes_before_and_after_the_call():
+    """Both busy paths: the sampled coordinator lock (pre-check) and
+    the BUSY_RESULT dict from losing the race to the admin endpoint."""
+
+    async def run():
+        m = Metrics()
+        # pre-check: snapshot says a transition is running
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0, reshard_busy=True))
+        scaler, clock = _scaler(feed, rs, windows=1, metrics=m)
+        (d,) = await _steps(scaler, clock, 1)
+        assert d.action == VETO and d.reason == "reshard_busy"
+        assert rs.calls == []
+        # post-hoc: the executor answers the coordinator's busy dict
+        rs2 = _FakeReshard(outcome="busy")
+        feed2 = _Feed(_snap(p99=50.0))
+        scaler2, clock2 = _scaler(feed2, rs2, windows=1, metrics=m)
+        (d,) = await _steps(scaler2, clock2, 1)
+        assert d.action == VETO and d.reason == "reshard_busy"
+        assert rs2.calls == [4]  # called, refused, counted
+        assert m.sample("gubernator_tpu_autoscale_vetoes_total",
+                        {"reason": "reshard_busy"}) == 2
+
+    asyncio.run(run())
+
+
+def test_dry_run_records_act_but_never_actuates():
+    async def run():
+        m = Metrics()
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0))
+        scaler, clock = _scaler(feed, rs, windows=1, dry_run=True,
+                                metrics=m)
+        d = await _steps(scaler, clock, 5)
+        assert all(x.action == ACT and x.dry_run for x in d)
+        assert all(x.outcome == "dry_run" for x in d)
+        assert rs.calls == []  # the whole point
+        assert scaler.transitions_last_hour() == 0
+        assert m.sample("gubernator_tpu_autoscale_transitions_total",
+                        {"direction": "up"}) == 0
+        assert m.sample("gubernator_tpu_autoscale_decisions_total",
+                        {"action": "act"}) == 5
+
+    asyncio.run(run())
+
+
+def test_executor_failure_is_a_veto_not_a_dead_loop():
+    async def run():
+        rs = _FakeReshard(outcome="raise")
+        feed = _Feed(_snap(p99=50.0))
+        scaler, clock = _scaler(feed, rs, windows=1)
+        (d,) = await _steps(scaler, clock, 1)
+        assert d.action == VETO and d.reason == "reshard_error"
+
+    asyncio.run(run())
+
+
+def test_frozen_sample_is_skipped_not_counted_as_pressure():
+    """Samples taken during a cutover freeze (queue inflated by the
+    controller's own transition) must not feed the streaks."""
+
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0, frozen=True))
+        scaler, clock = _scaler(feed, rs, windows=2)
+        d = await _steps(scaler, clock, 10)
+        assert all(x.action == HOLD for x in d)
+        assert rs.calls == []
+
+    asyncio.run(run())
+
+
+def test_at_bound_holds_instead_of_acting():
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0, shards=8))  # already at max_shards
+        scaler, clock = _scaler(feed, rs, windows=1)
+        (d,) = await _steps(scaler, clock, 1)
+        assert d.action == HOLD and d.reason == "at_bound"
+        assert rs.calls == []
+
+    asyncio.run(run())
+
+
+def test_decision_ring_wraps_bounded():
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap())
+        scaler, clock = _scaler(feed, rs, windows=3, ring_size=8)
+        await _steps(scaler, clock, 50)
+        assert len(scaler.ring) == 8
+        state = scaler.debug_state()
+        assert len(state["decisions"]) == 8
+        # newest entry survives the wrap
+        assert state["last_decision"]["ts"] == pytest.approx(
+            scaler.ring[-1].ts)
+
+    asyncio.run(run())
+
+
+def test_supervised_loop_runs_on_injected_clock():
+    """start()/stop() with the ManualClock sleep: each loop turn is one
+    interval sleep + one step; no wall-clock waits anywhere."""
+
+    async def run():
+        rs = _FakeReshard()
+        feed = _Feed(_snap(p99=50.0))
+        clock = ManualClock()
+
+        async def vsleep(dt):
+            # ManualClock.sleep plus one real yield so the test task
+            # interleaves with the supervised loop.
+            await clock.sleep(dt)
+            await asyncio.sleep(0)
+
+        policy = AutoscalePolicy(PolicyConfig(windows=1, target_p99_ms=5.0))
+        scaler = Autoscaler(feed, rs, policy=policy, dry_run=False,
+                            interval=10.0, clock=clock, sleep=vsleep)
+        scaler.start()
+        for _ in range(40):
+            if rs.calls:
+                break
+            await asyncio.sleep(0)  # let the loop turn on virtual time
+        await scaler.stop()
+        assert rs.calls and rs.calls[0] == 4
+        assert clock.sleeps and all(s == 10.0 for s in clock.sleeps)
+
+    asyncio.run(run())
+
+
+def test_policy_target_shards_doubles_halves_and_clamps():
+    p = AutoscalePolicy(PolicyConfig(min_shards=2, max_shards=8))
+    assert p.target_shards(2, UP) == 4
+    assert p.target_shards(8, UP) == 8
+    assert p.target_shards(4, DOWN) == 2
+    assert p.target_shards(2, DOWN) == 2
+
+
+def test_config_rejects_overlapping_hysteresis():
+    from gubernator_tpu.config import setup_daemon_config
+
+    with pytest.raises(ValueError, match="GUBER_AUTOSCALE_HYSTERESIS"):
+        setup_daemon_config(environ={
+            "GUBER_AUTOSCALE_HYSTERESIS": "1.0",
+        })
+    with pytest.raises(ValueError, match="GUBER_AUTOSCALE_MAX_SHARDS"):
+        setup_daemon_config(environ={
+            "GUBER_AUTOSCALE_MIN_SHARDS": "4",
+            "GUBER_AUTOSCALE_MAX_SHARDS": "2",
+        })

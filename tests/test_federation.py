@@ -557,3 +557,119 @@ def test_transfer_ownership_never_pushes_cross_region():
             await inst.close()
 
     asyncio.run(run())
+
+
+# ----------------------------------------------------------------------
+# Reshard × federation interlock (docs/resharding.md)
+# ----------------------------------------------------------------------
+def test_reshard_pauses_federation_no_envelope_from_half_relayout():
+    """Regression for the PR 18 × PR 14 interplay: the coordinator's
+    freeze pauses the intra-region GLOBAL reconcile but the federation
+    flush loop kept compacting envelopes mid-cutover — an envelope built
+    then snapshots half-relayouted owner state and exports it to every
+    remote region.  Two-region in-process cluster (home ``us``, fake
+    ``eu`` owner peer) on a ManualClock: a flush tick firing while the
+    engine is mid-relayout must build and send NOTHING; the first tick
+    after commit drains every delta accumulated under the pause."""
+    import threading
+
+    from gubernator_tpu.parallel.reshard import ReshardCoordinator
+    from gubernator_tpu.resilience import ManualClock
+    from gubernator_tpu.federation.manager import FederationManager
+
+    async def run():
+        clock = ManualClock()
+        peer = _FakeRemotePeer("eu-1:81")
+        inst = _fake_instance([peer])
+        # ManualClock drives the manager's timestamps; the supervised
+        # loop keeps the default sleep (the 60 s interval never fires
+        # in-test) and the test drives flush ticks explicitly — same
+        # discipline as the channel tests above.
+        fed = FederationManager(inst, epoch="boot-1", clock=clock)
+        in_cutover = threading.Event()
+        release = threading.Event()
+
+        class _HalfRelayoutEngine:
+            """Engine whose reshard() parks mid-relayout until released
+            — the window where owner state is torn."""
+
+            n_shards = 2
+
+            def cache_size(self):
+                return 0
+
+            def export_items(self):
+                return []
+
+            def reshard(self, new_shards):
+                in_cutover.set()
+                assert release.wait(5), "test never released the cutover"
+                self.n_shards = new_shards
+                return {"live_items": 0}
+
+        coord = ReshardCoordinator(_HalfRelayoutEngine(), federation=fed)
+        try:
+            loop = asyncio.get_running_loop()
+            fut = loop.run_in_executor(None, coord.reshard, 4)
+            await loop.run_in_executor(None, in_cutover.wait)
+            # Owner-side delta lands mid-relayout.  The explicit tick
+            # below is exactly what the supervised loop (and the
+            # force_retry final flush on the close path) would run: it
+            # must not compact or send a single envelope while the
+            # cutover holds the pause.
+            fed.queue(_mr_req("k1"))
+            await fed._flush_once(force_retry=True)
+            assert peer.received == []
+            assert not fed._channels, "envelope compacted mid-relayout"
+            assert fed.pending_keys() == 1  # delta retained, not lost
+            release.set()
+            assert (await fut)["outcome"] == "committed"
+            # After commit the pause lifts and the same tick drains it.
+            await fed._flush_once(force_retry=True)
+            assert [e.seq for e in peer.received] == [1]
+            assert {r.unique_key for r in peer.received[0].records} == {"k1"}
+        finally:
+            release.set()
+            await fed.close()
+
+    asyncio.run(run())
+
+
+def test_reshard_abort_resumes_federation_sends():
+    """An aborted transition must not leave federation paused forever —
+    the coordinator's finally block resumes on every exit path."""
+    from gubernator_tpu.parallel.reshard import ReshardCoordinator
+    from gubernator_tpu.resilience import ManualClock
+    from gubernator_tpu.federation.manager import FederationManager
+
+    async def run():
+        clock = ManualClock()
+        peer = _FakeRemotePeer("eu-1:81")
+        inst = _fake_instance([peer])
+        fed = FederationManager(inst, epoch="boot-1", clock=clock)
+
+        class _ExplodingEngine:
+            n_shards = 2
+
+            def cache_size(self):
+                return 0
+
+            def export_items(self):
+                return []
+
+            def reshard(self, new_shards):
+                raise RuntimeError("relayout OOM (rolled back)")
+
+        coord = ReshardCoordinator(_ExplodingEngine(), federation=fed)
+        try:
+            fed.queue(_mr_req("k2"))
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(None, coord.reshard, 4)
+            assert out["outcome"] == "aborted"
+            assert not fed._paused
+            await fed._flush_once(force_retry=True)
+            assert [e.seq for e in peer.received] == [1]
+        finally:
+            await fed.close()
+
+    asyncio.run(run())

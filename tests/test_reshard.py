@@ -414,3 +414,65 @@ def test_reshard_ragged_zipf_round_trip_zero_loss():
         for t in range(2):
             serve_and_compare(t0 + t)
     assert eng.metric_routed_overflows == 0
+
+
+def test_try_reshard_busy_dict_is_single_source_of_truth():
+    """The concurrent-call outcome is one defined dict from the
+    coordinator (BUSY_RESULT) — Instance.reshard, /debug/reshard's 409,
+    and the autoscaler's reshard_busy veto all consume it instead of
+    string-matching the error."""
+    from gubernator_tpu.parallel.reshard import BUSY_RESULT
+
+    coord = ReshardCoordinator(_StubEngine(items=_items(1)))
+    assert not coord.is_busy()
+    assert coord._lock.acquire(blocking=False)  # simulate a running one
+    try:
+        assert coord.is_busy()
+        out = coord.try_reshard(2)
+        assert out == BUSY_RESULT
+        assert out is not BUSY_RESULT  # a copy; callers can't mutate it
+        # the raising wrapper stays the compat surface
+        with pytest.raises(ReshardError, match="already running"):
+            coord.reshard(2)
+    finally:
+        coord._lock.release()
+    assert not coord.is_busy()
+    # bad targets still raise on BOTH entry points — busy is the only
+    # non-raising outcome
+    with pytest.raises(ReshardError):
+        coord.try_reshard(0)
+    assert coord.try_reshard(2)["outcome"] == "committed"
+
+
+def test_coordinator_pauses_federation_sends():
+    """Mirror of the global-mesh pause: federation envelope sends stop
+    at FREEZE and resume after commit AND after abort (the finally)."""
+
+    class _Pausable:
+        def __init__(self):
+            self.paused = 0
+            self.log = []
+
+        def pause(self):
+            self.paused += 1
+            self.log.append("pause")
+
+        def resume(self):
+            self.paused -= 1
+            self.log.append("resume")
+
+    fed = _Pausable()
+    coord = ReshardCoordinator(
+        _StubEngine(items=_items(1)), tick_loop=_StubLoop(), federation=fed,
+    )
+    assert coord.reshard(2)["outcome"] == "committed"
+    assert fed.log == ["pause", "resume"] and fed.paused == 0
+
+    # abort path: drain timeout — the finally must still resume
+    fed2 = _Pausable()
+    coord2 = ReshardCoordinator(
+        _StubEngine(items=_items(1)), tick_loop=_StubLoop(quiesce_ok=False),
+        federation=fed2, freeze_timeout=0.01,
+    )
+    assert coord2.reshard(2)["outcome"] == "aborted"
+    assert fed2.log == ["pause", "resume"] and fed2.paused == 0
