@@ -30,6 +30,7 @@ from __future__ import annotations
 import numpy as np
 
 from gubernator_tpu.types import ALGORITHM_MAX, Algorithm
+from gubernator_tpu.utils.hotpath import hot_path
 
 # Zoo members (selected when ``algorithm >= ZOO_MIN``); token/leaky stay
 # on the legacy two-way select inside the bucket transitions.
@@ -46,14 +47,17 @@ ZOO_ALGORITHMS = (
 ZOO_STATE_FIELDS = ("tat", "prev_count")
 
 
+@hot_path
 def invalid_algorithm_mask(algorithm: np.ndarray) -> np.ndarray:
     """Boolean mask of wire ``algorithm`` values outside the enum range.
 
     Used by the edges (fastwire / protobuf conversion / instance
     validation) to reject unknown algorithms with INVALID_ARGUMENT
     instead of letting them fall through the select tree as
-    token-bucket.
+    token-bucket.  Runs once per decoded wire window (fastwire
+    ``parse_req``) — marked so G001 visits it directly.
     """
+    # guber: allow-G001(wire validation over the host-decoded algorithm column - never a device value)
     a = np.asarray(algorithm)
     return (a < 0) | (a > int(ALGORITHM_MAX))
 

@@ -1,26 +1,39 @@
 """guberlint — the project's AST-based invariant checker.
 
-Six bug classes this repo has already shipped (and hand-fixed, one PR at
-a time) are statically detectable properties of the source tree.  This
-package locks them down:
+Ten bug classes this repo has already shipped (and hand-fixed, one PR
+at a time) are statically detectable properties of the source tree.
+This package locks them down:
 
 ==== =============================================================
-G001 device-sync primitive inside a ``@hot_path`` serving function
+G001 device-sync / blocking syscall in (or reachable from) @hot_path
 G002 blocking call in ``async def`` / ``await`` under a held lock
 G003 fire-and-forget asyncio task (handle discarded)
 G004 ``GUBER_*`` env read outside the config registry / undocumented
 G005 Prometheus metric names drifting from ``docs/prometheus.md``
 G006 impure host calls inside jit/shard_map-traced functions
+G007 blocking call reachable while a threading lock is held
+G008 lock-order cycle in the package-wide acquisition graph
+G009 unguarded cross-thread shared state (background-thread targets)
+G010 admission-deadline taint into supervised background queues
 ==== =============================================================
+
+Since v2 the checker is *interprocedural*: analysis/callgraph.py builds
+a package-wide call graph (module-qualified def/method resolution,
+best-effort on dynamic dispatch, no edge when unresolvable), and G001,
+G002, G007, and G008 propagate their scope taint through resolved
+callees.  The runtime twin — lock-order and SPSC single-writer
+sanitizers behind ``GUBER_SANITIZERS=1`` (utils/sanitize.py) — covers
+the dynamic-dispatch half the static graph cannot see.
 
 Pure stdlib on purpose: ``python -m gubernator_tpu.analysis`` and the
 tier-1 test that wraps it never import jax (or any third-party module),
 so the gate runs anywhere in well under a second.
 
 Suppression: ``# guber: allow-G003(reason)`` on the finding's line or
-the line above.  The reason is mandatory — an empty one leaves the
-finding live.  Grandfathered findings live in a checked-in baseline
-(``.guberlint-baseline.json``); see docs/static-analysis.md.
+the line above (rule id case-insensitive).  The reason is mandatory —
+an empty one leaves the finding live.  Grandfathered findings live in a
+checked-in baseline (``.guberlint-baseline.json``); see
+docs/static-analysis.md.
 """
 
 from gubernator_tpu.analysis.core import (
@@ -35,6 +48,7 @@ from gubernator_tpu.analysis.core import (
     write_baseline,
 )
 from gubernator_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+from gubernator_tpu.analysis import concurrency as _conc  # noqa: F401
 
 __all__ = [
     "Finding",

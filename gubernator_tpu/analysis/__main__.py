@@ -6,7 +6,7 @@ or baselined; 1 when any live finding remains; 2 on usage errors.
 Usage:
     python -m gubernator_tpu.analysis [--root DIR] [--package NAME]
         [--baseline PATH | --no-baseline] [--update-baseline]
-        [--rules G001,G004] [--json] [--list-rules] [-q]
+        [--rules G001,G004] [--json] [--sarif PATH] [--list-rules] [-q]
 """
 
 from __future__ import annotations
@@ -27,6 +27,58 @@ from gubernator_tpu.analysis.core import (
 from gubernator_tpu.analysis import rules as _rules  # noqa: F401
 
 
+def sarif_report(findings) -> dict:
+    """SARIF 2.1.0 document for the given findings: one run, the full
+    rule catalog under tool.driver.rules, one result per finding with
+    a physical location (code-scanning upload shape)."""
+    rules = [
+        {
+            "id": rid,
+            "name": RULES[rid].title,
+            "shortDescription": {"text": RULES[rid].title},
+            "fullDescription": {"text": RULES[rid].description},
+            "help": {"text": RULES[rid].fix_hint},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rid in sorted(RULES)
+    ]
+    index = {rid: i for i, rid in enumerate(sorted(RULES))}
+    results = [
+        {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": f.line},
+                },
+            }],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "guberlint",
+                    "informationUri": (
+                        "https://github.com/gubernator-io/gubernator"),
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m gubernator_tpu.analysis",
@@ -45,6 +97,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids (default: all)")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 (for code "
+                         "scanning upload); '-' for stdout")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -88,6 +143,15 @@ def main(argv=None) -> int:
               f"{baseline_path} — edit each 'reason' to a real "
               "justification (or fix the code)")
         return 0
+
+    if args.sarif:
+        doc = sarif_report(result.findings)
+        if args.sarif == "-":
+            print(json.dumps(doc, indent=2))
+        else:
+            with open(args.sarif, "w") as fh:
+                json.dump(doc, fh, indent=2)
+                fh.write("\n")
 
     if args.as_json:
         print(json.dumps({

@@ -1,0 +1,568 @@
+"""Package-wide AST call graph: the interprocedural half of guberlint.
+
+One :class:`CallGraph` per :class:`~gubernator_tpu.analysis.core.Project`
+indexes every module, class, method, and nested def into qualified
+names, resolves imports (including aliases and one-hop re-exports), and
+turns ``ast.Call`` nodes into edges.  Rules use it to make scope taint
+transitive: ``@hot_path`` (G001), async context (G002), held locks
+(G007/G008), and supervised-loop reachability (G009/G010) all propagate
+through resolved callees.
+
+Resolution is deliberately conservative — **best-effort on static
+dispatch, silent on dynamic dispatch**:
+
+* plain names resolve through nested-def scopes, module defs, and
+  imports (``import a.b as c`` / ``from a.b import c as d``, re-exports
+  followed up to a small depth);
+* ``self.method()`` resolves in the enclosing class and its
+  project-local bases;
+* ``self.attr.method()`` resolves only when ``attr``'s type is inferable
+  from ``__init__``-style assignments (``self.attr = ClassName(...)`` or
+  ``self.attr = param`` with an annotated parameter);
+* everything else — duck-typed receivers, callables passed as values,
+  monkey-patched names — produces **no edge**.  A missed edge can hide a
+  finding; an invented edge fabricates one.  The linter takes the miss.
+
+External (non-project) names still resolve to a *canonical* dotted path
+(``from time import sleep as zzz; zzz()`` → ``time.sleep``) so primitive
+matching in rules survives aliasing.
+
+Pure stdlib, and never imports the inspected modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from gubernator_tpu.analysis.core import Project, SourceFile
+
+# Result kinds from resolve():  ("func", FuncInfo) | ("class", ClassInfo)
+# | ("mod", ModuleInfo) | ("ext", "dotted.canonical.name") | None.
+_MAX_REEXPORT_DEPTH = 6
+
+
+def qual_parts(node: ast.AST) -> List[str]:
+    """['os', 'environ', 'get'] for a Name/Attribute chain; [] otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def iter_stmts_skip_nested(body: Iterable[ast.stmt]) -> Iterable[ast.AST]:
+    """Walk statements without entering nested def/lambda bodies — the
+    callgraph gives every nested def its own node, so its statements
+    must not leak into the parent's."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def direct_nested_defs(fn: ast.AST) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for node in iter_stmts_skip_nested(fn.body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+    return out
+
+
+def decorator_names(fn: ast.AST) -> Set[str]:
+    """Terminal decorator name segments: @utils.hot_path → {'hot_path'}."""
+    names: Set[str] = set()
+    for d in getattr(fn, "decorator_list", []):
+        if isinstance(d, ast.Call):
+            d = d.func
+        parts = qual_parts(d)
+        if parts:
+            names.add(parts[-1])
+    return names
+
+
+class FuncInfo:
+    """One def/method/nested def with enough context to resolve from."""
+
+    __slots__ = ("qname", "node", "sf", "module", "cls", "parent",
+                 "children", "is_async")
+
+    def __init__(self, qname, node, sf, module, cls, parent):
+        self.qname: str = qname
+        self.node = node
+        self.sf: SourceFile = sf
+        self.module: "ModuleInfo" = module
+        self.cls: Optional["ClassInfo"] = cls
+        self.parent: Optional["FuncInfo"] = parent
+        self.children: Dict[str, "FuncInfo"] = {}
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def short(self) -> str:
+        """Human label: 'Class.method' or 'func'."""
+        if self.cls is not None:
+            return f"{self.cls.name}.{self.name}"
+        return self.name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<FuncInfo {self.qname}>"
+
+
+class ClassInfo:
+    __slots__ = ("qname", "name", "node", "sf", "module", "base_names",
+                 "methods", "attr_types")
+
+    def __init__(self, qname, name, node, sf, module, base_names):
+        self.qname: str = qname
+        self.name: str = name
+        self.node = node
+        self.sf: SourceFile = sf
+        self.module: "ModuleInfo" = module
+        self.base_names: List[List[str]] = base_names  # raw dotted parts
+        self.methods: Dict[str, FuncInfo] = {}
+        # attr -> canonical type name: a project class qname, or an
+        # external dotted name ("threading.RLock", "queue.Queue").
+        # Conflicting inferences poison the entry (dropped).
+        self.attr_types: Dict[str, str] = {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<ClassInfo {self.qname}>"
+
+
+class ModuleInfo:
+    __slots__ = ("name", "sf", "is_pkg", "imports", "functions", "classes")
+
+    def __init__(self, name: str, sf: SourceFile, is_pkg: bool):
+        self.name = name
+        self.sf = sf
+        self.is_pkg = is_pkg
+        # alias -> ("mod", dotted) | ("sym", dotted_module, symbol)
+        self.imports: Dict[str, Tuple] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+
+
+def modname_of(path: str) -> Optional[str]:
+    if not path.endswith(".py"):
+        return None
+    parts = path[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class CallGraph:
+    """Index + resolver + edge cache over one project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._edge_cache: Dict[str, List[Tuple[FuncInfo, int]]] = {}
+        self._bases_cache: Dict[str, List[ClassInfo]] = {}
+        self._by_node: Dict[int, FuncInfo] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            name = modname_of(sf.path)
+            if name is None:
+                continue
+            mod = ModuleInfo(name, sf, sf.path.endswith("/__init__.py"))
+            self.modules[name] = mod
+        for mod in self.modules.values():
+            self._index_module(mod)
+        for ci in list(self.classes.values()):
+            self._infer_attr_types(ci)
+
+    def func_of(self, node: ast.AST) -> Optional["FuncInfo"]:
+        """The FuncInfo indexed for a given def node (None for defs the
+        index skipped, e.g. methods of nested classes)."""
+        return self._by_node.get(id(node))
+
+    @classmethod
+    def of(cls, project: Project) -> "CallGraph":
+        """Build once per project; rules share the cached instance."""
+        cg = getattr(project, "_guber_callgraph", None)
+        if cg is None:
+            cg = cls(project)
+            project._guber_callgraph = cg
+        return cg
+
+    # ------------------------------------------------------------------
+    # Indexing
+    # ------------------------------------------------------------------
+    def _index_module(self, mod: ModuleInfo) -> None:
+        tree = mod.sf.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        mod.imports[a.asname] = ("mod", a.name)
+                    else:
+                        head = a.name.split(".")[0]
+                        mod.imports.setdefault(head, ("mod", head))
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(mod, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.imports[a.asname or a.name] = ("sym", base, a.name)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(mod, stmt, cls=None, parent=None,
+                               prefix=mod.name)
+            elif isinstance(stmt, ast.ClassDef):
+                self._add_class(mod, stmt)
+
+    def _import_base(self, mod: ModuleInfo,
+                     node: ast.ImportFrom) -> Optional[str]:
+        if not node.level:
+            return node.module or None
+        parts = mod.name.split(".")
+        drop = node.level if not mod.is_pkg else node.level - 1
+        if drop > 0:
+            parts = parts[:-drop] if drop < len(parts) else []
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) if parts else None
+
+    def _add_func(self, mod, node, cls, parent, prefix) -> None:
+        qname = f"{prefix}.{node.name}"
+        fi = FuncInfo(qname, node, mod.sf, mod, cls, parent)
+        self.functions[qname] = fi
+        self._by_node[id(node)] = fi
+        if parent is not None:
+            parent.children[node.name] = fi
+        elif cls is not None:
+            # First def wins on duplicates (@property getter vs setter).
+            cls.methods.setdefault(node.name, fi)
+        else:
+            mod.functions.setdefault(node.name, fi)
+        for child in direct_nested_defs(node):
+            self._add_func(mod, child, cls=cls, parent=fi,
+                           prefix=f"{qname}.<locals>")
+
+    def _add_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        qname = f"{mod.name}.{node.name}"
+        bases = [p for b in node.bases if (p := qual_parts(b))]
+        ci = ClassInfo(qname, node.name, node, mod.sf, mod, bases)
+        self.classes[qname] = ci
+        mod.classes.setdefault(node.name, ci)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(mod, stmt, cls=ci, parent=None, prefix=qname)
+
+    # ------------------------------------------------------------------
+    # Attribute type inference (self.attr = ...)
+    # ------------------------------------------------------------------
+    def _infer_attr_types(self, ci: ClassInfo) -> None:
+        for m in ci.methods.values():
+            ann: Dict[str, ast.AST] = {}
+            a = m.node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                if arg.annotation is not None:
+                    ann[arg.arg] = arg.annotation
+            for node in ast.walk(m.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                tq = self._value_type(node.value, m, ann)
+                if tq is None:
+                    continue
+                prev = ci.attr_types.get(t.attr)
+                if prev is None:
+                    ci.attr_types[t.attr] = tq
+                elif prev != tq:
+                    ci.attr_types[t.attr] = "?"  # poisoned: conflicting
+        for attr in [k for k, v in ci.attr_types.items() if v == "?"]:
+            del ci.attr_types[attr]
+
+    def _value_type(self, value: ast.AST, scope: FuncInfo,
+                    ann: Dict[str, ast.AST]) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            r = self.resolve(qual_parts(value.func), scope)
+            if r is None:
+                return None
+            if r[0] == "class":
+                return r[1].qname
+            if r[0] == "ext":
+                return r[1]
+            return None
+        if isinstance(value, ast.Name) and value.id in ann:
+            return self._annotation_type(ann[value.id], scope)
+        return None
+
+    def _annotation_type(self, node: ast.AST,
+                         scope: FuncInfo) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(node, ast.Subscript):
+            # Optional[X] / "X | None": take the concrete arm.
+            base = qual_parts(node.value)
+            if base and base[-1] == "Optional":
+                node = node.slice
+            else:
+                return None
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            for side in (node.left, node.right):
+                if not (isinstance(side, ast.Constant)
+                        and side.value is None):
+                    node = side
+                    break
+        parts = qual_parts(node)
+        if not parts:
+            return None
+        r = self.resolve(parts, scope)
+        if r is None:
+            return None
+        if r[0] == "class":
+            return r[1].qname
+        if r[0] == "ext":
+            return r[1]
+        return None
+
+    # ------------------------------------------------------------------
+    # Resolution
+    # ------------------------------------------------------------------
+    def class_method(self, ci: ClassInfo, name: str) -> Optional[FuncInfo]:
+        """Method lookup through project-local bases (cycle-safe)."""
+        seen: Set[str] = set()
+        stack = [ci]
+        while stack:
+            c = stack.pop(0)
+            if c.qname in seen:
+                continue
+            seen.add(c.qname)
+            m = c.methods.get(name)
+            if m is not None:
+                return m
+            stack.extend(self._bases(c))
+        return None
+
+    def _bases(self, ci: ClassInfo) -> List[ClassInfo]:
+        cached = self._bases_cache.get(ci.qname)
+        if cached is None:
+            cached = []
+            for parts in ci.base_names:
+                r = self._resolve_in_module(parts, ci.module)
+                if r is not None and r[0] == "class":
+                    cached.append(r[1])
+            self._bases_cache[ci.qname] = cached
+        return cached
+
+    def _lookup_symbol(self, modname: str, name: str, depth: int = 0):
+        sub = self.modules.get(f"{modname}.{name}")
+        if sub is not None:
+            return ("mod", sub)
+        mi = self.modules.get(modname)
+        if mi is None:
+            return ("ext", f"{modname}.{name}" if modname else name)
+        if name in mi.functions:
+            return ("func", mi.functions[name])
+        if name in mi.classes:
+            return ("class", mi.classes[name])
+        imp = mi.imports.get(name)
+        if imp is not None and depth < _MAX_REEXPORT_DEPTH:
+            return self._resolve_import(imp, depth + 1)
+        return None  # defined some dynamic way — unknown, not external
+
+    def _resolve_import(self, imp: Tuple, depth: int = 0):
+        if imp[0] == "mod":
+            mi = self.modules.get(imp[1])
+            if mi is not None:
+                return ("mod", mi)
+            return ("ext", imp[1])
+        _, base, name = imp
+        return self._lookup_symbol(base, name, depth)
+
+    def _resolve_self(self, rest: List[str], ci: ClassInfo):
+        if len(rest) == 1:
+            m = self.class_method(ci, rest[0])
+            if m is not None:
+                return ("func", m)
+            return None
+        if len(rest) == 2:
+            t = ci.attr_types.get(rest[0])
+            if t is None:
+                return None
+            target = self.classes.get(t)
+            if target is not None:
+                m = self.class_method(target, rest[1])
+                return ("func", m) if m is not None else None
+            return ("ext", f"{t}.{rest[1]}")
+        return None
+
+    def _resolve_in_module(self, parts: List[str], mod: ModuleInfo,
+                           scope: Optional[FuncInfo] = None):
+        head = parts[0]
+        cur = None
+        if scope is not None and len(parts) == 1:
+            p = scope
+            while p is not None:
+                if head in p.children:
+                    return ("func", p.children[head])
+                p = p.parent
+        if head in mod.functions:
+            cur = ("func", mod.functions[head])
+        elif head in mod.classes:
+            cur = ("class", mod.classes[head])
+        elif head in mod.imports:
+            cur = self._resolve_import(mod.imports[head])
+        if cur is None:
+            # Unqualified builtin or module-global we didn't index: treat
+            # the raw dotted name as its own canonical external form.
+            return ("ext", ".".join(parts))
+        for i, part in enumerate(parts[1:], 1):
+            kind, val = cur
+            if kind == "mod":
+                cur = self._lookup_symbol(val.name, part)
+                if cur is None:
+                    return None
+            elif kind == "ext":
+                return ("ext", val + "." + ".".join(parts[i:]))
+            elif kind == "class":
+                m = self.class_method(val, part)
+                if m is None:
+                    return None
+                cur = ("func", m)
+            else:  # attribute access on a function object — unknown
+                return None
+        return cur
+
+    def resolve(self, parts: List[str], scope: Optional[FuncInfo]):
+        """Resolve a dotted name seen inside ``scope``.  Returns
+        ("func", FuncInfo) | ("class", ClassInfo) | ("mod", ModuleInfo) |
+        ("ext", canonical) | None (dynamic/unknown — no edge)."""
+        if not parts:
+            return None
+        if parts[0] in ("self", "cls") and scope is not None \
+                and scope.cls is not None:
+            if len(parts) == 1:
+                return None
+            return self._resolve_self(parts[1:], scope.cls)
+        if scope is not None:
+            return self._resolve_in_module(parts, scope.module, scope)
+        return None
+
+    def resolve_expr(self, expr: ast.AST, scope: FuncInfo):
+        return self.resolve(qual_parts(expr), scope)
+
+    def canonical(self, expr: ast.AST, scope: FuncInfo) -> str:
+        """Canonical external name of an expression ('' for project-local
+        or unresolvable): survives ``from time import sleep as zzz``."""
+        r = self.resolve_expr(expr, scope)
+        if r is not None and r[0] == "ext":
+            return r[1]
+        return ""
+
+    def callable_target(self, expr: ast.AST,
+                        scope: FuncInfo) -> Optional[FuncInfo]:
+        """A function *reference* (not call): spawn targets, callbacks."""
+        r = self.resolve_expr(expr, scope)
+        if r is not None and r[0] == "func":
+            return r[1]
+        if r is not None and r[0] == "class":
+            return self.class_method(r[1], "__init__")
+        return None
+
+    # ------------------------------------------------------------------
+    # Edges
+    # ------------------------------------------------------------------
+    def edges(self, fi: FuncInfo) -> List[Tuple[FuncInfo, int]]:
+        """(callee, call lineno) for every resolvable direct call in
+        ``fi``'s own body (nested defs excluded — they get their own
+        node, and merely *defining* one runs nothing)."""
+        cached = self._edge_cache.get(fi.qname)
+        if cached is not None:
+            return cached
+        out: List[Tuple[FuncInfo, int]] = []
+        for node in iter_stmts_skip_nested(fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            r = self.resolve_expr(node.func, fi)
+            if r is None:
+                continue
+            if r[0] == "func":
+                out.append((r[1], node.lineno))
+            elif r[0] == "class":
+                init = self.class_method(r[1], "__init__")
+                if init is not None:
+                    out.append((init, node.lineno))
+        out.sort(key=lambda e: e[1])
+        self._edge_cache[fi.qname] = out
+        return out
+
+
+class PrimHit:
+    """A primitive call reached from inside one function: the chain of
+    functions walked (starting at the function itself), the function
+    holding the primitive, and its location."""
+
+    __slots__ = ("chain", "fi", "lineno", "label")
+
+    def __init__(self, chain: Tuple[FuncInfo, ...], fi: FuncInfo,
+                 lineno: int, label: str):
+        self.chain = chain
+        self.fi = fi
+        self.lineno = lineno
+        self.label = label
+
+    def describe(self) -> str:
+        path = " -> ".join(f.short for f in self.chain)
+        return (f"{self.label} via {path} "
+                f"({self.fi.sf.path}:{self.lineno})")
+
+
+def first_primitive(cg: CallGraph, fi: FuncInfo, direct_fn, memo: Dict,
+                    skip_fn=None) -> Optional[PrimHit]:
+    """First primitive (per ``direct_fn``) reachable from inside ``fi``
+    through resolved call edges — ``fi``'s own body first, then callees
+    in call order.  ``direct_fn(fi) -> [(lineno, label)]`` scans one
+    body; ``skip_fn(fi) -> bool`` prunes traversal (e.g. callees that
+    carry their own ``@hot_path`` marker are checked directly).  ``memo``
+    is a per-(rule, project) dict; cycles resolve to None."""
+    key = fi.qname
+    if key in memo:
+        return memo[key]
+    memo[key] = None  # in-progress marker: recursion terminates
+    hit: Optional[PrimHit] = None
+    hits = direct_fn(fi)
+    if hits:
+        lineno, label = min(hits)
+        hit = PrimHit((fi,), fi, lineno, label)
+    else:
+        for callee, _ln in cg.edges(fi):
+            if callee.qname == fi.qname:
+                continue
+            if skip_fn is not None and skip_fn(callee):
+                continue
+            sub = first_primitive(cg, callee, direct_fn, memo, skip_fn)
+            if sub is not None:
+                hit = PrimHit((fi,) + sub.chain, sub.fi, sub.lineno,
+                              sub.label)
+                break
+    memo[key] = hit
+    return hit

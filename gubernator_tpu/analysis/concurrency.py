@@ -1,0 +1,835 @@
+"""Concurrency rules G007-G010: the interprocedural bug classes.
+
+Each rule is grounded in a bug this repo has already shipped and
+root-caused:
+
+* **G007** — blocking call reachable while a ``threading`` lock is held
+  (the PR 12 ColdStore sink-under-lock class, enforced everywhere and
+  made transitive through the call graph).
+* **G008** — lock-order cycles: two locks acquired in opposite nesting
+  orders *anywhere* in the package, including through calls (the static
+  half of the runtime lock-order sanitizer in utils/sanitize.py).
+* **G009** — cross-thread shared mutable state: attributes written from
+  a ``spawn_supervised_thread``/``threading.Thread`` target and touched
+  elsewhere in the class with no lock on either side (the ring
+  double-serve / PR 13 class).  ``# guber: allow-g009(reason)`` marks
+  single-writer-by-design fields.
+* **G010** — background-task deadline taint: an object carrying an
+  admission ``deadline`` stored into a container drained by a supervised
+  loop (the exact PR 17 federation bug, generalized).
+
+Known resolution limits (see docs/static-analysis.md): dynamic dispatch
+produces no edge, so a blocking call behind an un-inferable attribute
+does not flag — the runtime sanitizers (GUBER_SANITIZERS=1) cover that
+half.  G009 deliberately scopes to *thread* targets: ``spawn_supervised``
+(asyncio) loop state is event-loop-confined by construction, and
+flagging it would drown the signal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from gubernator_tpu.analysis.core import Finding, Project, Rule, register
+from gubernator_tpu.analysis.callgraph import (
+    CallGraph,
+    ClassInfo,
+    FuncInfo,
+    first_primitive,
+    iter_stmts_skip_nested,
+    qual_parts,
+)
+
+# ----------------------------------------------------------------------
+# Shared: what blocks a thread, what looks like a lock
+# ----------------------------------------------------------------------
+_LOCKISH = re.compile(r"(^|_)(lock|cond|mutex|sem)[a-z0-9]*$", re.I)
+_QUEUEISH = re.compile(r"(^|_)(q|queue)\d*$", re.I)
+_SOCKISH = re.compile(r"(sock|conn)", re.I)
+
+_BLOCKING_EXACT = {
+    "time.sleep", "os.fsync", "os.fdatasync", "open", "io.open", "os.open",
+    "mmap.mmap", "select.select", "socket.create_connection",
+}
+_QUEUE_TYPES = ("queue.Queue", "queue.LifoQueue", "queue.PriorityQueue")
+_QUEUE_BLOCK_METHODS = {"put", "get", "join"}
+_SOCK_METHODS = {"send", "sendall", "sendto", "recv", "recv_into",
+                 "recvfrom", "accept", "connect"}
+# Operations *on a lock object* are the lock-order rule's domain (G008),
+# and Condition.wait releases the lock it waits on — never G007 material.
+_LOCK_METHODS = {"acquire", "release", "wait", "wait_for", "notify",
+                 "notify_all", "locked", "set", "is_set"}
+
+
+def _const_eq(node: ast.AST, value) -> bool:
+    return isinstance(node, ast.Constant) and node.value == value
+
+
+def _nonblocking_queue_call(call: ast.Call) -> bool:
+    """put/get with block=False or timeout=0 doesn't block."""
+    for kw in call.keywords:
+        if kw.arg == "block" and _const_eq(kw.value, False):
+            return True
+        if kw.arg == "timeout" and _const_eq(kw.value, 0):
+            return True
+    # Queue.put(item, block) / Queue.get(block) positional forms.
+    attr = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+    pos = 1 if attr == "put" else 0
+    if len(call.args) > pos and _const_eq(call.args[pos], False):
+        return True
+    return False
+
+
+def blocking_call_label(call: ast.Call, parts: List[str],
+                        canonical: str) -> Optional[str]:
+    """Canonical label when this call blocks the calling thread (sleep,
+    fsync, open, socket I/O, subprocess, blocking queue put/get), else
+    None.  ``canonical`` is the callgraph-resolved external name ('' when
+    project-local/unknown); ``parts`` the raw dotted chain."""
+    attr = parts[-1] if parts else ""
+    recv_term = parts[-2] if len(parts) >= 2 else ""
+    if attr in ("put_nowait", "get_nowait"):
+        return None
+    if attr in _LOCK_METHODS and _LOCKISH.search(recv_term):
+        return None
+    if canonical in _BLOCKING_EXACT:
+        return canonical
+    if canonical.startswith("subprocess."):
+        return canonical
+    for qt in _QUEUE_TYPES:
+        if canonical.startswith(qt + "."):
+            if attr in _QUEUE_BLOCK_METHODS and \
+                    not _nonblocking_queue_call(call):
+                return canonical
+            return None
+    if canonical.startswith("socket.") and attr in _SOCK_METHODS:
+        return canonical
+    # Untyped receivers: name-shape heuristics (the _resolve_q.put /
+    # sock.recv idiom).  Receiver-less bare names never match here.
+    if attr in _QUEUE_BLOCK_METHODS and _QUEUEISH.search(recv_term) and \
+            not _nonblocking_queue_call(call):
+        return ".".join(parts)
+    if attr in _SOCK_METHODS and _SOCKISH.search(recv_term):
+        return ".".join(parts)
+    return None
+
+
+def line_allowed(sf, lineno: int, rule: str) -> bool:
+    """Inline allow-comment (with a non-empty reason) at a *primitive's*
+    own line — lets one suppression in a shared helper cover every
+    transitive caller, mirroring SourceFile.suppressed placement."""
+    for ln in (lineno, lineno - 1):
+        for rid, reason in sf.suppressions.get(ln, []):
+            if rid == rule and reason:
+                return True
+    return False
+
+
+def lockish_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        q = qual_parts(expr.func)
+        if q and q[-1] in ("Lock", "RLock", "Condition", "Semaphore",
+                           "BoundedSemaphore"):
+            return True
+        expr = expr.func
+    q = qual_parts(expr)
+    return bool(q) and bool(_LOCKISH.search(q[-1]))
+
+
+def lock_identity(expr: ast.AST, fi: FuncInfo) -> Optional[Tuple[str, str]]:
+    """(lock id, kind) for a lock-ish with-item.  Identity is
+    class-scoped (``TickLoop._cond``) so every instance of a class maps
+    to one graph node — the package-wide ordering discipline is per
+    class attribute, not per object.  kind is the canonical ctor
+    ('threading.RLock', ...) when __init__ inference knows it."""
+    if isinstance(expr, ast.Call):
+        return None  # inline Lock(): no cross-function identity
+    parts = qual_parts(expr)
+    if not parts or not _LOCKISH.search(parts[-1]):
+        return None
+    kind = ""
+    if parts[0] in ("self", "cls") and fi.cls is not None:
+        lid = f"{fi.cls.name}.{'.'.join(parts[1:])}"
+        if len(parts) == 2:
+            kind = fi.cls.attr_types.get(parts[1], "")
+    else:
+        lid = f"{fi.module.name}:{'.'.join(parts)}"
+    return lid, kind
+
+
+def lock_regions(fi: FuncInfo) -> List[Tuple[ast.With, str, str]]:
+    """(with-node, lock id, kind) for every ``with <lock>:`` region in
+    fi's own body, outermost first, in source order."""
+    out: List[Tuple[ast.With, str, str]] = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.With):
+            continue
+        for it in node.items:
+            if not lockish_expr(it.context_expr):
+                continue
+            ident = lock_identity(it.context_expr, fi)
+            if ident is None:
+                q = qual_parts(it.context_expr)
+                ident = (".".join(q) if q else "<lock>", "")
+            out.append((node, ident[0], ident[1]))
+            break
+    out.sort(key=lambda r: (r[0].lineno, r[0].col_offset))
+    return out
+
+
+# ----------------------------------------------------------------------
+# G007 — blocking call reachable while a lock is held
+# ----------------------------------------------------------------------
+def _resolved_callee(cg: CallGraph, call: ast.Call,
+                     fi: FuncInfo) -> Optional[FuncInfo]:
+    r = cg.resolve_expr(call.func, fi)
+    if r is None:
+        return None
+    if r[0] == "func":
+        return r[1]
+    if r[0] == "class":
+        return cg.class_method(r[1], "__init__")
+    return None
+
+
+def _g007(project: Project) -> Iterable[Finding]:
+    cg = CallGraph.of(project)
+    memo: Dict[str, object] = {}
+
+    def direct(fi: FuncInfo) -> List[Tuple[int, str]]:
+        hits: List[Tuple[int, str]] = []
+        for node in iter_stmts_skip_nested(fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = qual_parts(node.func)
+            canonical = cg.canonical(node.func, fi) if parts else ""
+            label = blocking_call_label(node, parts, canonical)
+            if label and not line_allowed(fi.sf, node.lineno, "G007"):
+                hits.append((node.lineno, label))
+        return hits
+
+    def skip(fi: FuncInfo) -> bool:
+        return fi.is_async  # sync code can't *run* an async callee
+
+    hint = ("ship the blocking work outside the critical section: "
+            "collect under the lock, act after release (the PR 12 "
+            "ColdStore fix), or hand it to the background writer")
+    seen_sites: Set[Tuple[str, int]] = set()
+    for qname in sorted(cg.functions):
+        fi = cg.functions[qname]
+        for withnode, lid, _kind in lock_regions(fi):
+            for node in iter_stmts_skip_nested(withnode.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = (fi.sf.path, node.lineno)
+                if site in seen_sites:
+                    continue
+                parts = qual_parts(node.func)
+                canonical = cg.canonical(node.func, fi) if parts else ""
+                label = blocking_call_label(node, parts, canonical)
+                if label:
+                    seen_sites.add(site)
+                    yield Finding(
+                        "G007", fi.sf.path, node.lineno,
+                        f"blocking call {label} while holding {lid} in "
+                        f"'{fi.short}' — every thread contending on the "
+                        "lock stalls behind it", hint,
+                    )
+                    continue
+                callee = _resolved_callee(cg, node, fi)
+                if callee is None or skip(callee) or \
+                        callee.qname == fi.qname:
+                    continue
+                sub = first_primitive(cg, callee, direct, memo, skip)
+                if sub is not None:
+                    seen_sites.add(site)
+                    yield Finding(
+                        "G007", fi.sf.path, node.lineno,
+                        f"call to '{callee.short}' while holding {lid} "
+                        f"in '{fi.short}' reaches blocking "
+                        f"{sub.describe()}", hint,
+                    )
+
+
+register(Rule(
+    "G007", "blocking call under a held lock",
+    "sleep / fsync / open / socket send-recv / subprocess / blocking "
+    "queue put-get reachable (transitively, through resolved calls) "
+    "while a threading.Lock/RLock/Condition is held.",
+    "Collect under the lock, act after release; blocking work never "
+    "shares a critical section with the serving path.",
+    _g007,
+))
+
+
+# ----------------------------------------------------------------------
+# G008 — lock-order cycles in the static acquisition graph
+# ----------------------------------------------------------------------
+def _g008(project: Project) -> Iterable[Finding]:
+    cg = CallGraph.of(project)
+    acq_memo: Dict[str, Set[str]] = {}
+
+    def acquired(fi: FuncInfo) -> Set[str]:
+        """Transitive set of lock ids this function may acquire."""
+        cached = acq_memo.get(fi.qname)
+        if cached is not None:
+            return cached
+        acq_memo[fi.qname] = set()  # cycle guard
+        out: Set[str] = set()
+        for _w, lid, _k in lock_regions(fi):
+            out.add(lid)
+        for callee, _ln in cg.edges(fi):
+            out |= acquired(callee)
+        acq_memo[fi.qname] = out
+        return out
+
+    # edge (outer, inner) -> (path, line, description)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, fi: FuncInfo, lineno: int,
+                 via: str = "") -> None:
+        if a == b:
+            # Same class-scoped id: either a reentrant RLock or two
+            # *instances* of one class — neither is an ordering fact the
+            # static graph can decide.  The runtime sanitizer owns it.
+            return
+        key = (a, b)
+        if key not in edges:
+            note = f" via call to {via}" if via else ""
+            edges[key] = (fi.sf.path, lineno,
+                          f"{a} -> {b} ({fi.sf.path}:{lineno}{note})")
+
+    def scan_expr(fi: FuncInfo, expr: ast.AST,
+                  held: List[str]) -> None:
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                              ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call) and held:
+                callee = _resolved_callee(cg, n, fi)
+                if callee is not None and callee.qname != fi.qname:
+                    for m in sorted(acquired(callee)):
+                        for h in held:
+                            add_edge(h, m, fi, n.lineno, callee.short)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def scan_stmt(fi: FuncInfo, node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.With):
+            ids: List[str] = []
+            for it in node.items:
+                scan_expr(fi, it.context_expr, held)
+                if lockish_expr(it.context_expr):
+                    ident = lock_identity(it.context_expr, fi)
+                    if ident is not None:
+                        ids.append(ident[0])
+            for h in held:
+                for lid in ids:
+                    add_edge(h, lid, fi, node.lineno)
+            for i in range(len(ids)):
+                for j in range(i + 1, len(ids)):
+                    add_edge(ids[i], ids[j], fi, node.lineno)
+            for stmt in node.body:
+                scan_stmt(fi, stmt, held + ids)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                scan_stmt(fi, child, held)
+            else:
+                scan_expr(fi, child, held)
+
+    for qname in sorted(cg.functions):
+        fi = cg.functions[qname]
+        for stmt in fi.node.body:
+            scan_stmt(fi, stmt, [])
+
+    # Strongly connected components of the acquisition digraph: any SCC
+    # with >= 2 locks means two opposite-order paths exist somewhere.
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    for k in adj:
+        adj[k].sort()
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:  # iterative Tarjan
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(pi, len(adj[node])):
+                w = adj[node][i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sorted(sccs):
+        members = set(comp)
+        internal = sorted(
+            edges[k][2] for k in edges
+            if k[0] in members and k[1] in members
+        )
+        locs = sorted(
+            (edges[k][0], edges[k][1]) for k in edges
+            if k[0] in members and k[1] in members
+        )
+        path, line = locs[0]
+        shown = "; ".join(internal[:6])
+        if len(internal) > 6:
+            shown += f"; ... {len(internal) - 6} more"
+        yield Finding(
+            "G008", path, line,
+            f"lock-order cycle among {{{', '.join(comp)}}}: {shown} — "
+            "two threads taking these locks in opposite orders can "
+            "deadlock",
+            "pick one global order (docs/concurrency.md) and release "
+            "the outer lock before any path that re-enters the other; "
+            "GUBER_SANITIZERS=1 catches the dynamic counterpart with "
+            "both stacks",
+        )
+
+
+register(Rule(
+    "G008", "lock-order cycle",
+    "The package-wide static lock acquisition graph (nested with-blocks "
+    "plus lock sets of resolved callees) contains a cycle: two locks "
+    "are taken in opposite nesting orders somewhere.",
+    "One global lock order per docs/concurrency.md; never call back "
+    "into another locked subsystem while holding your own lock.",
+    _g008,
+))
+
+
+# ----------------------------------------------------------------------
+# G009 — cross-thread shared mutable state without a lock
+# ----------------------------------------------------------------------
+_MUTATOR_METHODS = {"append", "appendleft", "add", "remove", "discard",
+                    "pop", "popleft", "clear", "update", "extend",
+                    "insert", "setdefault"}
+_THREADSAFE_TYPES = ("queue.", "threading.", "collections.deque",
+                     "multiprocessing.")
+
+
+def _thread_targets(cg: CallGraph, ci: ClassInfo,
+                    tails: Tuple[str, ...]) -> List[FuncInfo]:
+    """Entry points of background loops this class spawns, resolved from
+    spawn call sites in any of its methods."""
+    out: List[FuncInfo] = []
+    for m in ci.methods.values():
+        for node in ast.walk(m.node):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = qual_parts(node.func)
+            if not parts or parts[-1] not in tails:
+                continue
+            target_expr: Optional[ast.AST] = None
+            if parts[-1] == "Thread":
+                canonical = cg.canonical(node.func, m)
+                if canonical != "threading.Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+                if target_expr is None and len(node.args) > 1:
+                    target_expr = node.args[1]
+            else:
+                if node.args:
+                    target_expr = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg in ("target", "factory"):
+                        target_expr = kw.value
+            if target_expr is None:
+                continue
+            fi = cg.callable_target(target_expr, m)
+            if fi is not None and fi.cls is ci:
+                out.append(fi)
+    return out
+
+
+def _same_class_closure(cg: CallGraph, ci: ClassInfo,
+                        roots: List[FuncInfo]) -> Set[str]:
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        fi = stack.pop()
+        if fi.qname in seen:
+            continue
+        seen.add(fi.qname)
+        for callee, _ln in cg.edges(fi):
+            if callee.cls is ci and callee.qname not in seen:
+                stack.append(callee)
+    return seen
+
+
+class _Access:
+    __slots__ = ("attr", "write", "lineno", "guarded", "const_write",
+                 "fi")
+
+    def __init__(self, attr, write, lineno, guarded, const_write, fi):
+        self.attr = attr
+        self.write = write
+        self.lineno = lineno
+        self.guarded = guarded
+        self.const_write = const_write
+        self.fi = fi
+
+
+def _attr_accesses(fi: FuncInfo) -> List[_Access]:
+    """Every ``self.X`` touch in fi (nested defs included — closures run
+    on the same thread as their caller), tagged with whether it sits
+    lexically inside a ``with <lock>:`` region."""
+    out: List[_Access] = []
+
+    def self_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            return node.attr
+        return None
+
+    def is_const(v: ast.AST) -> bool:
+        if isinstance(v, ast.UnaryOp):
+            v = v.operand
+        return isinstance(v, ast.Constant)
+
+    def scan(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.With):
+            g2 = guarded or any(
+                lockish_expr(it.context_expr) for it in node.items
+            )
+            for it in node.items:
+                scan(it.context_expr, guarded)
+            for stmt in node.body:
+                scan(stmt, g2)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                a = self_attr(t)
+                if a is not None:
+                    out.append(_Access(a, True, node.lineno, guarded,
+                                       is_const(node.value), fi))
+                elif isinstance(t, ast.Subscript):
+                    a = self_attr(t.value)
+                    if a is not None:
+                        out.append(_Access(a, True, node.lineno, guarded,
+                                           False, fi))
+            scan(node.value, guarded)
+            for t in node.targets:
+                if not (self_attr(t) or isinstance(t, ast.Subscript)):
+                    scan(t, guarded)
+            return
+        if isinstance(node, ast.AugAssign):
+            a = self_attr(node.target)
+            if a is None and isinstance(node.target, ast.Subscript):
+                a = self_attr(node.target.value)
+            if a is not None:
+                out.append(_Access(a, True, node.lineno, guarded, False,
+                                   fi))
+            scan(node.value, guarded)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = self_attr(t)
+                if a is None and isinstance(t, ast.Subscript):
+                    a = self_attr(t.value)
+                if a is not None:
+                    out.append(_Access(a, True, node.lineno, guarded,
+                                       False, fi))
+            return
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS:
+            a = self_attr(node.func.value)
+            if a is not None:
+                out.append(_Access(a, True, node.lineno, guarded, False,
+                                   fi))
+            for c in list(node.args) + [kw.value for kw in node.keywords]:
+                scan(c, guarded)
+            return
+        a = self_attr(node)
+        if a is not None:
+            out.append(_Access(a, False, node.lineno, guarded, False, fi))
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, guarded)
+
+    for stmt in fi.node.body:
+        scan(stmt, False)
+    return out
+
+
+def _g009(project: Project) -> Iterable[Finding]:
+    cg = CallGraph.of(project)
+    for qname in sorted(cg.classes):
+        ci = cg.classes[qname]
+        targets = _thread_targets(
+            cg, ci, ("spawn_supervised_thread", "Thread"))
+        if not targets:
+            continue
+        loop_set = _same_class_closure(cg, ci, targets)
+        inside: Dict[str, List[_Access]] = {}
+        outside: Dict[str, List[_Access]] = {}
+        for m in ci.methods.values():
+            fis = [m] + [c for c in m.children.values()]
+            in_loop = m.qname in loop_set
+            for f in fis:
+                for acc in _attr_accesses(f):
+                    if in_loop or f.qname in loop_set:
+                        inside.setdefault(acc.attr, []).append(acc)
+                    elif m.name not in ("__init__", "__post_init__"):
+                        outside.setdefault(acc.attr, []).append(acc)
+        loop_names = ", ".join(sorted({t.short for t in targets}))
+        for attr in sorted(set(inside) & set(outside)):
+            if attr.startswith("metric_"):
+                continue  # documented single-writer telemetry convention
+            t = ci.attr_types.get(attr, "")
+            if t.startswith(_THREADSAFE_TYPES):
+                continue
+            in_writes = [a for a in inside[attr] if a.write]
+            if not in_writes:
+                continue
+            all_writes = in_writes + [a for a in outside[attr] if a.write]
+            if all_writes and all(a.const_write for a in all_writes):
+                continue  # monotonic flag publication (_running = False)
+            in_unguarded = [a for a in in_writes if not a.guarded]
+            out_unguarded = [a for a in outside[attr] if not a.guarded]
+            if not in_unguarded and not out_unguarded:
+                continue  # both sides lock-guarded
+            racy = min(in_unguarded or in_writes,
+                       key=lambda a: a.lineno)
+            others = sorted({a.lineno for a in outside[attr]})[:4]
+            yield Finding(
+                "G009", ci.sf.path, racy.lineno,
+                f"self.{attr} written from background-thread target "
+                f"'{loop_names}' and touched from other methods of "
+                f"{ci.name} (lines {', '.join(map(str, others))}) with "
+                "no lock on at least one side — a cross-thread data "
+                "race",
+                "guard both sides with the owning lock, or mark the "
+                "field single-writer-by-design with "
+                "# guber: allow-g009(reason)",
+            )
+
+
+register(Rule(
+    "G009", "unguarded cross-thread shared state",
+    "An attribute written inside a spawn_supervised_thread / "
+    "threading.Thread target (or its same-class callees) and touched "
+    "from other methods, with no lock on at least one side.",
+    "Every field shared with a background thread is lock-guarded or "
+    "explicitly declared single-writer with allow-g009(reason).",
+    _g009,
+))
+
+
+# ----------------------------------------------------------------------
+# G010 — deadline taint into supervised background queues
+# ----------------------------------------------------------------------
+_STORE_METHODS = {"append", "appendleft", "add", "put", "put_nowait",
+                  "insert", "setdefault"}
+
+
+def _deadline_classes(cg: CallGraph) -> Set[str]:
+    out: Set[str] = set()
+    for ci in cg.classes.values():
+        for stmt in ci.node.body:
+            name = None
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+            if name == "deadline":
+                out.add(ci.qname)
+                out.add(ci.name)
+                break
+    return out
+
+
+def _g010(project: Project) -> Iterable[Finding]:
+    cg = CallGraph.of(project)
+    dl_classes = _deadline_classes(cg)
+    if not dl_classes:
+        return
+    for qname in sorted(cg.classes):
+        ci = cg.classes[qname]
+        targets = _thread_targets(
+            cg, ci, ("spawn_supervised", "spawn_supervised_thread"))
+        if not targets:
+            continue
+        loop_set = _same_class_closure(cg, ci, targets)
+        loop_names = ", ".join(sorted({t.short for t in targets}))
+        # Containers the background loop actually drains.
+        loop_attrs: Set[str] = set()
+        for t_qname in loop_set:
+            fi = cg.functions.get(t_qname)
+            if fi is None:
+                continue
+            for acc in _attr_accesses(fi):
+                loop_attrs.add(acc.attr)
+        if not loop_attrs:
+            continue
+        for m in sorted(ci.methods.values(), key=lambda f: f.qname):
+            if m.qname in loop_set or m.name == "__init__":
+                continue
+            yield from _g010_scan_method(cg, ci, m, dl_classes,
+                                         loop_attrs, loop_names)
+
+
+def _g010_scan_method(cg, ci, m, dl_classes, loop_attrs,
+                      loop_names) -> Iterable[Finding]:
+    tainted: Set[str] = set()
+    ann_of: Dict[str, Optional[str]] = {}
+    a = m.node.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        if arg.annotation is None:
+            continue
+        t = cg._annotation_type(arg.annotation, m)
+        if t is not None and (t in dl_classes
+                              or t.split(".")[-1] in dl_classes):
+            tainted.add(arg.arg)
+            ann_of[arg.arg] = t
+
+    def clones_tainted(value: ast.AST) -> Optional[str]:
+        """Name of the tainted source when value is a clone of it:
+        Cls(**vars(x)) / replace(x, ...) with no deadline= override."""
+        if not isinstance(value, ast.Call):
+            return None
+        for kw in value.keywords:
+            if kw.arg == "deadline":
+                return None  # explicit deadline: author decided
+            if kw.arg is None and isinstance(kw.value, ast.Call):
+                inner = kw.value
+                if qual_parts(inner.func)[-1:] == ["vars"] and \
+                        inner.args and \
+                        isinstance(inner.args[0], ast.Name) and \
+                        inner.args[0].id in tainted:
+                    return inner.args[0].id
+        parts = qual_parts(value.func)
+        if parts and parts[-1] == "replace" and value.args and \
+                isinstance(value.args[0], ast.Name) and \
+                value.args[0].id in tainted:
+            return value.args[0].id
+        return None
+
+    # Events in source order: a linear pass is exact enough for the
+    # stamp-then-store idiom this rule encodes (queue_hit's fix).
+    events: List[Tuple[int, int, str, object]] = []
+    for node in ast.walk(m.node):
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            continue
+        col = getattr(node, "col_offset", 0)
+        if isinstance(node, ast.Assign):
+            t = node.targets[0] if len(node.targets) == 1 else None
+            if isinstance(t, ast.Name):
+                events.append((lineno, col, "assign", (t.id, node.value)))
+            elif isinstance(t, ast.Attribute) and t.attr == "deadline" \
+                    and isinstance(t.value, ast.Name):
+                events.append((lineno, col, "clear", t.value.id))
+            elif isinstance(t, ast.Subscript):
+                sa = t.value
+                if isinstance(sa, ast.Attribute) and \
+                        isinstance(sa.value, ast.Name) and \
+                        sa.value.id == "self" and \
+                        isinstance(node.value, ast.Name):
+                    events.append((lineno, col, "store",
+                                   (sa.attr, node.value.id)))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _STORE_METHODS:
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                for argv in node.args:
+                    if isinstance(argv, ast.Name):
+                        events.append((lineno, node.col_offset, "store",
+                                       (recv.attr, argv.id)))
+    events.sort(key=lambda e: (e[0], e[1]))
+    for lineno, _col, kind, payload in events:
+        if kind == "clear":
+            tainted.discard(payload)
+        elif kind == "assign":
+            name, value = payload
+            if isinstance(value, ast.Name) and value.id in tainted:
+                tainted.add(name)
+            else:
+                src = clones_tainted(value)
+                if src is not None:
+                    tainted.add(name)
+                    ann_of[name] = ann_of.get(src)
+                else:
+                    tainted.discard(name)
+        elif kind == "store":
+            attr, name = payload
+            if name in tainted and attr in loop_attrs:
+                t = ann_of.get(name) or "a deadline-carrying type"
+                yield Finding(
+                    "G010", m.sf.path, lineno,
+                    f"'{name}' ({t} — carries the caller's admission "
+                    f"deadline) stored into self.{attr}, which the "
+                    f"supervised loop '{loop_names}' drains: the "
+                    "background path inherits a serving-path deadline "
+                    "and sheds or expires asynchronously (the PR 17 "
+                    "federation bug class)",
+                    "clear it first (obj.deadline = None) or store a "
+                    "deadline-free clone before enqueueing "
+                    "(service/global_manager.queue_hit shows the "
+                    "pattern)",
+                )
+
+
+register(Rule(
+    "G010", "deadline taint into background queues",
+    "An object whose type carries an admission `deadline` field is "
+    "stored, deadline intact, into a container drained by a "
+    "spawn_supervised(_thread) loop.",
+    "Background work never inherits a serving-path deadline: clear it "
+    "or clone without it before enqueueing.",
+    _g010,
+))

@@ -23,8 +23,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 # ``# guber: allow-G001(reason)`` — the reason is part of the syntax, not
-# decoration: a suppression with an empty reason does not suppress.
-SUPPRESS_RE = re.compile(r"#\s*guber:\s*allow-(G\d{3})\(([^()]*)\)")
+# decoration: a suppression with an empty reason does not suppress.  The
+# rule id is case-insensitive (allow-g009 == allow-G009).
+SUPPRESS_RE = re.compile(r"#\s*guber:\s*allow-([Gg]\d{3})\(([^()]*)\)")
 
 
 @dataclass(frozen=True)
@@ -80,7 +81,7 @@ class SourceFile:
                     continue
                 for m in SUPPRESS_RE.finditer(tok.string):
                     self.suppressions.setdefault(tok.start[0], []).append(
-                        (m.group(1), m.group(2).strip())
+                        (m.group(1).upper(), m.group(2).strip())
                     )
         except (tokenize.TokenError, IndentationError):
             pass

@@ -1,12 +1,16 @@
-"""The six guberlint rules (G001-G006), each grounded in a bug class
-this repo has already shipped and hand-fixed at least once.
+"""The core guberlint rules (G001-G006), each grounded in a bug class
+this repo has already shipped and hand-fixed at least once.  The
+concurrency rules (G007-G010) live in analysis/concurrency.py.
 
-All rules are pure AST walks — no imports of the inspected modules, no
-type inference.  Where static truth is unreachable (is this ``asarray``
-argument a device buffer or host numpy?) the rules err toward flagging
-inside an explicitly marked scope and let the author answer with a
-reason-carrying ``# guber: allow-…`` comment; an invariant you have to
-argue for in writing is the point.
+All rules are pure AST walks — no imports of the inspected modules.
+Since guberlint v2, G001 and G002 are *transitive*: the package call
+graph (analysis/callgraph.py) propagates @hot_path and async-context
+taint through resolved callees, so a primitive hidden one call deep in
+a helper flags at the call site.  Where static truth is unreachable (is
+this ``asarray`` argument a device buffer or host numpy?) the rules err
+toward flagging inside an explicitly marked scope and let the author
+answer with a reason-carrying ``# guber: allow-…`` comment; an
+invariant you have to argue for in writing is the point.
 """
 
 from __future__ import annotations
@@ -16,6 +20,17 @@ import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from gubernator_tpu.analysis.core import Finding, Project, Rule, register
+from gubernator_tpu.analysis.callgraph import (
+    CallGraph,
+    FuncInfo,
+    decorator_names,
+    first_primitive,
+    iter_stmts_skip_nested,
+)
+from gubernator_tpu.analysis.concurrency import (
+    blocking_call_label,
+    line_allowed,
+)
 
 # ----------------------------------------------------------------------
 # Shared AST helpers
@@ -85,77 +100,138 @@ _G001_ASARRAY_BASES = {"np", "numpy", "onp"}
 _G001_FILE_CALLS = {"open", "os.open", "os.fsync", "mmap.mmap"}
 
 
+def _g001_match(node: ast.Call, q: str,
+                canonical: str) -> Optional[Tuple[str, bool]]:
+    """(label, is_blocking_syscall) when this call is a G001 primitive:
+    a device sync, or a thread-blocking syscall (file I/O, sleep,
+    socket send/recv, blocking queue put/get, subprocess)."""
+    if q in _G001_CALLS:
+        return q, False
+    if q in _G001_FILE_CALLS or canonical in _G001_FILE_CALLS:
+        return f"{q or canonical}()", True
+    if q.split(".")[-1] == "block_until_ready":
+        return (q or ".block_until_ready()"), False
+    if (
+        q.split(".")[-1] in ("asarray", "array")
+        and q.split(".")[0] in _G001_ASARRAY_BASES
+    ):
+        return q, False
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "item"
+        and not node.args
+    ):
+        return ".item()", False
+    if (
+        isinstance(node.func, ast.Name)
+        and node.func.id in ("float", "bool")
+        and len(node.args) == 1
+        and not isinstance(node.args[0], ast.Constant)
+    ):
+        return f"{node.func.id}()", False
+    # Blocking-syscall family (socket send/recv, blocking Queue.put/get,
+    # subprocess, sleep): the edge drain path's gap — any of these on a
+    # dispatch thread is a per-tick stall exactly like an fsync.
+    label = blocking_call_label(node, q.split(".") if q else [], canonical)
+    if label is not None:
+        return label, True
+    return None
+
+
+def _is_hot(fi: FuncInfo) -> bool:
+    return "hot_path" in decorator_names(fi.node)
+
+
 def _g001(project: Project) -> Iterable[Finding]:
     hint = ("queue the device work and materialize it on the resolver "
             "side (TickHandle.result / resolve_ticks), or move this off "
             "the per-tick path")
-    for sf in project.files:
-        if sf.tree is None:
-            continue
-        for fn in functions(sf.tree):
-            if not any(
-                qual_name(d).split(".")[-1] == "hot_path"
-                or (isinstance(d, ast.Call)
-                    and qual_name(d.func).split(".")[-1] == "hot_path")
-                for d in fn.decorator_list
-            ):
+    io_hint = ("blocking syscalls belong on the SSD tier's background "
+               "writer (SsdStore._writer_loop) or in a non-hot helper, "
+               "never inline on the dispatch thread")
+    cg = CallGraph.of(project)
+    memo: Dict[str, object] = {}
+
+    def direct(fi: FuncInfo) -> List[Tuple[int, str]]:
+        """Primitive sites in one body, minus inline-allowed ones (a
+        G001 allow at the primitive line covers every transitive
+        caller)."""
+        hits: List[Tuple[int, str]] = []
+        for node in iter_stmts_skip_nested(fi.node.body):
+            if not isinstance(node, ast.Call):
                 continue
-            for node in walk_skip_nested(fn.body):
-                if not isinstance(node, ast.Call):
-                    continue
-                q = qual_name(node.func)
-                bad: Optional[str] = None
-                file_io = False
-                if q in _G001_CALLS:
-                    bad = q
-                elif q in _G001_FILE_CALLS:
-                    bad, file_io = f"{q}()", True
-                elif q.split(".")[-1] == "block_until_ready":
-                    bad = q or ".block_until_ready()"
-                elif (
-                    q.split(".")[-1] in ("asarray", "array")
-                    and q.split(".")[0] in _G001_ASARRAY_BASES
-                ):
-                    bad = q
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "item"
-                    and not node.args
-                ):
-                    bad = ".item()"
-                elif (
-                    isinstance(node.func, ast.Name)
-                    and node.func.id in ("float", "bool")
-                    and len(node.args) == 1
-                    and not isinstance(node.args[0], ast.Constant)
-                ):
-                    bad = f"{node.func.id}()"
-                if bad and file_io:
+            q = qual_name(node.func)
+            m = _g001_match(node, q, cg.canonical(node.func, fi))
+            if m is not None and not line_allowed(fi.sf, node.lineno,
+                                                  "G001"):
+                hits.append((node.lineno, m[0]))
+        return hits
+
+    def skip(fi: FuncInfo) -> bool:
+        # Hot-marked callees get their own direct visit; async callees
+        # aren't *run* by a sync call expression.
+        return _is_hot(fi) or fi.is_async
+
+    for qname in sorted(cg.functions):
+        fi = cg.functions[qname]
+        if not _is_hot(fi):
+            continue
+        for node in iter_stmts_skip_nested(fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qual_name(node.func)
+            m = _g001_match(node, q, cg.canonical(node.func, fi))
+            if m is not None:
+                bad, blocking = m
+                if blocking:
                     yield Finding(
-                        "G001", sf.path, node.lineno,
-                        f"blocking file syscall {bad} inside @hot_path "
-                        f"function '{fn.name}' — a per-tick storage "
-                        "stall",
-                        "file I/O belongs on the SSD tier's background "
-                        "writer (SsdStore._writer_loop) or in a non-hot "
-                        "helper, never inline on the dispatch thread",
+                        "G001", fi.sf.path, node.lineno,
+                        f"blocking syscall {bad} inside @hot_path "
+                        f"function '{fi.name}' — a per-tick stall on "
+                        "the dispatch thread", io_hint,
                     )
-                elif bad:
+                else:
                     yield Finding(
-                        "G001", sf.path, node.lineno,
+                        "G001", fi.sf.path, node.lineno,
                         f"device-sync primitive {bad} inside @hot_path "
-                        f"function '{fn.name}' — a per-tick host/device "
+                        f"function '{fi.name}' — a per-tick host/device "
                         "round trip", hint,
                     )
+                continue
+            # Transitive: taint propagates through resolved callees, so
+            # a primitive one call deep in an unmarked helper flags at
+            # this call site.
+            r = cg.resolve_expr(node.func, fi)
+            callee: Optional[FuncInfo] = None
+            if r is not None and r[0] == "func":
+                callee = r[1]
+            elif r is not None and r[0] == "class":
+                callee = cg.class_method(r[1], "__init__")
+            if callee is None or callee.qname == fi.qname or skip(callee):
+                continue
+            sub = first_primitive(cg, callee, direct, memo, skip)
+            if sub is not None:
+                yield Finding(
+                    "G001", fi.sf.path, node.lineno,
+                    f"@hot_path function '{fi.name}' reaches "
+                    f"{sub.describe()} — the helper runs on the "
+                    "dispatch thread and stalls it exactly like an "
+                    "inline sync",
+                    "mark the helper @hot_path and fix it, or move the "
+                    "primitive off the per-tick path (an allow-comment "
+                    "at the primitive's own line covers all callers)",
+                )
 
 
 register(Rule(
-    "G001", "hot-path device sync / blocking file I/O",
+    "G001", "hot-path device sync / blocking syscall",
     "np.asarray / .item() / float()/bool() / block_until_ready / "
-    "jax.device_get, or a blocking file syscall (open / os.open / "
-    "os.fsync / mmap.mmap), inside a @hot_path serving function.",
+    "jax.device_get, or a thread-blocking syscall (open / os.fsync / "
+    "mmap.mmap / time.sleep / socket send-recv / blocking Queue "
+    "put-get / subprocess), inside — or transitively reachable from — "
+    "a @hot_path serving function.",
     "Dispatch, don't materialize: syncs belong on the resolver side, "
-    "file I/O on the SSD tier's background writer.",
+    "blocking I/O on the SSD tier's background writer.",
     _g001,
 ))
 
@@ -181,7 +257,31 @@ def _lockish_ctx(expr: ast.AST) -> bool:
     return bool(q) and bool(_LOCKISH.search(q.split(".")[-1]))
 
 
+def _g002_blocking_q(q: str, canonical: str) -> bool:
+    return (
+        q in _G002_BLOCKING or canonical in _G002_BLOCKING
+        or q in ("open", "io.open") or canonical in ("open", "io.open")
+    )
+
+
 def _g002(project: Project) -> Iterable[Finding]:
+    cg = CallGraph.of(project)
+    memo: Dict[str, object] = {}
+
+    def direct(fi: FuncInfo) -> List[Tuple[int, str]]:
+        hits: List[Tuple[int, str]] = []
+        for node in iter_stmts_skip_nested(fi.node.body):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qual_name(node.func)
+            if _g002_blocking_q(q, cg.canonical(node.func, fi)) and \
+                    not line_allowed(fi.sf, node.lineno, "G002"):
+                hits.append((node.lineno, q or "(call)"))
+        return hits
+
+    def skip(fi: FuncInfo) -> bool:
+        return fi.is_async  # awaited callees carry their own async taint
+
     for sf in project.files:
         if sf.tree is None:
             continue
@@ -212,17 +312,17 @@ def _g002(project: Project) -> Iterable[Finding]:
                             )
                 # (b) blocking sync calls on the event loop: fsync and
                 # friends stall EVERY coroutine (ticks, health probes,
-                # peer RPCs) for the duration.
+                # peer RPCs) for the duration.  Transitive since v2: a
+                # sync helper that opens/sleeps/fsyncs taints its async
+                # callers through the call graph.
                 for node in walk_skip_nested(fn.body):
                     if not isinstance(node, ast.Call):
                         continue
                     q = qual_name(node.func)
-                    blocking = (
-                        q in _G002_BLOCKING
-                        or q == "open"
-                        or q == "io.open"
-                    )
-                    if blocking:
+                    scope = cg.func_of(fn)
+                    canonical = (cg.canonical(node.func, scope)
+                                 if scope is not None else "")
+                    if _g002_blocking_q(q, canonical):
                         yield Finding(
                             "G002", sf.path, node.lineno,
                             f"blocking call {q or '(call)'}() inside "
@@ -231,6 +331,28 @@ def _g002(project: Project) -> Iterable[Finding]:
                             "await loop.run_in_executor(None, fn) or "
                             "asyncio.to_thread(fn) — see "
                             "persistence/writer.py",
+                        )
+                        continue
+                    if scope is None:
+                        continue
+                    r = cg.resolve_expr(node.func, scope)
+                    callee: Optional[FuncInfo] = None
+                    if r is not None and r[0] == "func":
+                        callee = r[1]
+                    if callee is None or callee.is_async or \
+                            callee.qname == scope.qname:
+                        continue
+                    sub = first_primitive(cg, callee, direct, memo, skip)
+                    if sub is not None:
+                        yield Finding(
+                            "G002", sf.path, node.lineno,
+                            f"async def '{fn.name}' reaches blocking "
+                            f"{sub.describe()} — the helper runs on "
+                            "the event loop and stalls every "
+                            "coroutine",
+                            "run the sync helper in an executor "
+                            "(asyncio.to_thread), or move the blocking "
+                            "primitive out of it",
                         )
 
 

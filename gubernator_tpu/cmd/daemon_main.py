@@ -17,6 +17,7 @@ from gubernator_tpu.transport.daemon import spawn_daemon
 
 
 async def run(config_file: str) -> None:
+    # guber: allow-G002(startup-only config read - the loop serves nothing until this returns)
     conf = setup_daemon_config(config_file)
     level = getattr(logging, conf.log_level.upper(), logging.INFO)
     if conf.log_format == "json":

@@ -138,6 +138,7 @@ ENV_REGISTRY: Dict[str, str] = {
     "GUBER_RESHARD_FREEZE_TIMEOUT": "reshard drain budget before abort",
     "GUBER_RESHARD_VERIFY": "audit the table after each reshard cutover",
     "GUBER_RESOLV_CONF": "dns discovery: resolv.conf path",
+    "GUBER_SANITIZERS": "runtime lock-order/SPSC sanitizers (tests only)",
     "GUBER_SHED_POLICY": "overload shed answers: fail-open/fail-closed",
     "GUBER_SLOW_WINDOW_MS": "slow-window watchdog threshold in ms (0 = off)",
     "GUBER_SNAPSHOT_DELTAS_PER_BASE": "delta records per base compaction",

@@ -129,6 +129,7 @@ class K8sPool:
             await asyncio.sleep(self.poll_interval)
 
     async def start(self) -> None:
+        # guber: allow-G002(startup-only session build - reads the service-account token once before the poll loop exists)
         self._session = self._make_session()
         self._task = asyncio.create_task(self._loop(), name="k8s-discovery")
 

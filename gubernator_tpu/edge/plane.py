@@ -55,6 +55,7 @@ from gubernator_tpu.edge.shmring import (
 from gubernator_tpu.ops.reqcols import ReqColumns
 from gubernator_tpu.utils import flightrec
 from gubernator_tpu.utils.hotpath import hot_path
+from gubernator_tpu.utils import sanitize
 
 log = logging.getLogger("gubernator.edge")
 
@@ -91,7 +92,7 @@ class _WorkerHandle:
         # Reentrant: a tick-loop future can complete inline during
         # submit (shutdown shed), firing _on_done on the drain thread
         # while _drain_once still holds the lock.
-        self.lock = threading.RLock()
+        self.lock = sanitize.rlock("_WorkerHandle.lock")
         self.proc = None
         self.restarts = 0
         self.shed_rows = 0

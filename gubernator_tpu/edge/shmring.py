@@ -34,6 +34,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from gubernator_tpu.ops.reqcols import ColumnArena
+from gubernator_tpu.utils import sanitize
 from gubernator_tpu.utils.hotpath import hot_path
 
 MAGIC = 0x45444745  # "EDGE"
@@ -262,6 +263,9 @@ class RequestRing:
         self.slabs = seg.slabs
         self.write_at = 0
         self.read_at = 0
+        # None unless GUBER_SANITIZERS=1 (docs/concurrency.md): per-ring
+        # single-writer checker, one attribute test on the off path.
+        self._san = sanitize.ring_sanitizer(f"RequestRing[{seg.shm.name}]")
 
     # -- producer (worker process) -------------------------------------
     def try_claim(self) -> Optional[int]:
@@ -284,6 +288,8 @@ class RequestRing:
         h[RQ_DEADLINE_NS] = deadline_ns
         h[RQ_DECODE_NS] = decode_ns
         h[RQ_GENERATION] = generation
+        if self._san is not None:
+            self._san.note_publish(idx)
         h[RQ_STATE] = PUBLISHED
         self.write_at = (idx + 1) % self.slabs
 
@@ -300,6 +306,8 @@ class RequestRing:
         h = self.hdr[idx]
         if int(h[RQ_STATE]) != PUBLISHED:
             return None
+        if self._san is not None:
+            self._san.note_pop(idx)
         h[RQ_STATE] = LEASED
         self.read_at = (idx + 1) % self.slabs
         return (
@@ -309,11 +317,17 @@ class RequestRing:
         )
 
     def free(self, idx: int) -> None:
+        if self._san is not None:
+            self._san.note_free(
+                idx, int(self.hdr[idx, RQ_STATE]) == PUBLISHED
+            )
         self.hdr[idx, RQ_STATE] = FREE
 
     def reset(self) -> None:
         """Crash recovery: drop every in-flight slab and rewind both
         cursors (the owner bumps the generation around this)."""
+        if self._san is not None:
+            self._san.note_reset()
         self.hdr[:] = 0
         self.write_at = 0
         self.read_at = 0
@@ -356,6 +370,10 @@ class ResponseRing:
         self.depth = seg.depth
         self.write_at = 0
         self.read_at = 0
+        # Consumer-side pin only: the producer side is deliberately
+        # multi-thread (tick-resolver and shed paths), serialized by
+        # the plane's per-worker lock rather than a thread pin.
+        self._san = sanitize.ring_sanitizer(f"ResponseRing[{seg.shm.name}]")
 
     # -- producer (owner process) --------------------------------------
     def try_publish(self, seqno: int, rows: int, mat: np.ndarray,
@@ -393,6 +411,8 @@ class ResponseRing:
             return None
         rows = int(h[RS_ROWS])
         err_len = int(h[RS_ERR_LEN])
+        if self._san is not None:
+            self._san.note_pop(idx)
         out = (
             int(h[RS_SEQNO]), rows, self.mat[idx, :, :rows],
             int(h[RS_ERR_COUNT]), bytes(self.err[idx, :err_len]),
@@ -402,9 +422,17 @@ class ResponseRing:
         return out
 
     def free_slot(self, idx: int) -> None:
+        if self._san is not None:
+            # A polled slot sits in the lease set; freeing a PUBLISHED
+            # slot that was never polled drops a response on the floor.
+            self._san.note_free(
+                idx, int(self.hdr[idx, RS_STATE]) == PUBLISHED
+            )
         self.hdr[idx, RS_STATE] = FREE
 
     def reset(self) -> None:
+        if self._san is not None:
+            self._san.note_reset()
         self.hdr[:] = 0
         self.write_at = 0
         self.read_at = 0

@@ -35,6 +35,7 @@ from gubernator_tpu.leases.protocol import (
     LeaseSyncAck,
     LeaseToken,
 )
+from gubernator_tpu.utils import sanitize
 
 # try_admit verdicts.
 ADMIT = "admit"          # consumed from the local lease
@@ -80,7 +81,7 @@ class LeaseCache:
         self.offline_grace_ms = int(offline_grace_ms)
         self.max_offline_extensions = int(max_offline_extensions)
         self._records: Dict[Tuple[str, str], _Record] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("LeaseCache._lock")
         self._closed = False
         self.metric_local_admits = 0
         self.metric_local_denies = 0

@@ -50,6 +50,7 @@ from gubernator_tpu.leases.protocol import (
 )
 from gubernator_tpu.leases.signing import LeaseSigner
 from gubernator_tpu.types import RateLimitRequest, Status
+from gubernator_tpu.utils import sanitize
 
 log = logging.getLogger("gubernator.leases")
 
@@ -148,7 +149,7 @@ class LeaseManager:
         # lifetime (and per process restart the random HMAC secret /
         # fresh ed25519 key already invalidates old tokens).
         self._gen_floor: Dict[Tuple[str, str], int] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("LeaseManager._lock")
         # Plain-int counters (the tick-loop delta-sync pattern mirrors
         # engine counters; these sync straight into prometheus families
         # at increment time since lease traffic is not per-tick-window).

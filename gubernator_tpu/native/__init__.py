@@ -31,6 +31,7 @@ def _try_build() -> None:
         return
     _build_attempted = True
     try:
+        # guber: allow-G001(one-shot memoized toolchain build at first use - every later hot-path call hits the cached .so) # guber: allow-G007(same one-shot build - serialized behind _build_attempted, a cold-start cost, never steady-state)
         subprocess.run(
             ["make", "-C", _DIR, "-s"],
             check=True,

@@ -60,6 +60,7 @@ from gubernator_tpu.types import (
 )
 from gubernator_tpu.utils import flightrec, timeutil, tracing
 from gubernator_tpu.utils.hotpath import hot_path
+from gubernator_tpu.utils import sanitize
 
 
 # Table storage layouts (see rowtable.py for the row design rationale):
@@ -281,8 +282,12 @@ def split_i64(v: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     )
 
 
+@hot_path
 def pack_wide_rows(m32: np.ndarray, name: str, values, ix) -> None:
-    """Host-side write of an int64 column as its (lo, hi) i32 pair."""
+    """Host-side write of an int64 column as its (lo, hi) i32 pair.
+    Runs per tick on the dispatch thread (every @hot_path packer funnels
+    through it) — marked so G001 visits it directly."""
+    # guber: allow-G001(host-side wire packing - values is a host list or np column, asarray is the cheap staging copy, never a device sync)
     lo, hi = split_i64(np.asarray(values, np.int64))
     r = REQ32_INDEX[name]
     m32[r, ix] = lo
@@ -344,6 +349,7 @@ def pack_request_matrix32(
         put_wide("greg_dur", greg[1])
 
 
+@hot_path
 def pack_cols_req32(m32: np.ndarray, cols, slots, known, now: int, ix) -> None:
     """Shard-aware columnar REQ32 fill: write one resolved batch's
     request columns into a staging slab — the ONE definition of how a
@@ -496,11 +502,15 @@ class StagingRing:
             self._leased = None
 
 
+@hot_path
 def join_i32_pair(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     """Host-side (lo, hi) int32 pair → int64 (the compact wire format's
-    inverse; two's complement preserved for negatives)."""
+    inverse; two's complement preserved for negatives).  Per-tick on the
+    dispatch thread (group/layer plan builds) — G001 visits it directly."""
     return (
+        # guber: allow-G001(host-side wire unpacking - inputs are host i32 rows, asarray is a view, never a device sync)
         (np.asarray(hi).astype(np.int64) << 32)
+        # guber: allow-G001(host-side wire unpacking - same as the hi row above)
         | np.asarray(lo).astype(np.uint32).astype(np.int64)
     )
 
@@ -1981,6 +1991,7 @@ def device_dead_bits(in_use, expire_field, now: int):
 
 def unpack_dead_bits(bits, capacity: int) -> np.ndarray:
     return np.unpackbits(
+        # guber: allow-G001(the deliberate reclaim D2H - materializing the packed dead bitmask is this helper's whole job; callers pay it off-lock, at most once per reclaim round, never per tick)
         np.asarray(bits), count=capacity, bitorder="little"
     ).astype(bool)
 
@@ -2088,10 +2099,11 @@ class TickHandle:
         # column is read at resolve time.
         self._limit_req = (
             None if limit_req is None
+            # guber: allow-G001(host column snapshot - limit_req is a host array; the copy is the pipelining contract, not a device sync)
             else np.array(limit_req[:n], np.int64, copy=True)
         )
         self._done: Optional[np.ndarray] = None
-        self._flock = threading.Lock()
+        self._flock = sanitize.lock("TickHandle._flock")
 
     def _finish(self, raw: np.ndarray) -> None:
         """Complete from an already-materialized device response matrix:
@@ -2346,7 +2358,7 @@ class TickEngine:
         # as dead (or two live keys could share a slot within one tick).
         self._pending: set = set()
         self._tick_count = 0
-        self._lock = threading.RLock()
+        self._lock = sanitize.rlock("TickEngine._lock")
         # Background reclaim (SURVEY §7 "reclaim off the serving path"):
         # when free slots dip under the low watermark AND the batch had
         # misses, a reclaimer thread runs TTL-then-LRU victim selection on
@@ -2583,6 +2595,7 @@ class TickEngine:
             def finish_remove():
                 for k in keys:
                     if k:
+                        # guber: allow-g009(Store.remove is the pluggable Store contract's thread-safe entry point; the engine calls it but never rebinds self.store after __init__)
                         self.store.remove(k.decode())
 
             return finish_remove
@@ -2712,6 +2725,7 @@ class TickEngine:
                 # D2H wait + cold-tier insert outside the lock.
                 finish = self._demote_dispatch(victims, self._last_now)
                 self.slots.release_batch(victims)
+                # guber: allow-g009(every post-start touch holds _lock; the unguarded peers are _warmup, which runs in __init__ before the reclaim thread exists)
                 self.state = evict_chunked(
                     self._evict, self.state, victims, self.capacity
                 )

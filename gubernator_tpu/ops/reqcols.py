@@ -31,6 +31,7 @@ import numpy as np
 
 from gubernator_tpu.types import RateLimitRequest
 from gubernator_tpu.utils.hotpath import hot_path
+from gubernator_tpu.utils import sanitize
 
 # `created_at` sentinel: proto3 optional presence maps to "server stamps
 # now" (gubernator.proto:172-182).  0 is a legal (if silly) client value,
@@ -295,7 +296,7 @@ class ColumnArena:
         self._blob = np.empty((self.n_slabs, self.blob_cap), np.uint8)
         self._busy = [False] * self.n_slabs
         self._next = 0
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("ColumnArena._lock")
         # Busy-slab plain-allocation fallback budget, per window: the
         # counter resets whenever a slab recycles (a window completed),
         # so sustained exhaustion — not a transient burst — is what

@@ -392,6 +392,7 @@ def row_device_dead_bits(state: RowState, now: int):
 
 
 def row_device_dead_mask(state: RowState, now: int, capacity: int) -> np.ndarray:
+    # guber: allow-G001(the deliberate reclaim D2H, row-layout twin of unpack_dead_bits - at most once per reclaim round, never per tick)
     bits = np.asarray(row_device_dead_bits(state, now))
     return np.unpackbits(bits, count=capacity, bitorder="little").astype(bool)
 

@@ -120,6 +120,7 @@ from gubernator_tpu.types import (
     RateLimitResponse,
 )
 from gubernator_tpu.utils import timeutil
+from gubernator_tpu.utils import sanitize
 
 I64 = jnp.int64
 I32 = jnp.int32
@@ -915,7 +916,7 @@ class MeshGlobalEngine:
         self._tick_count = 0
         self._last_reconcile_ms = 0
         self._reconcile_paused = 0
-        self._lock = threading.RLock()
+        self._lock = sanitize.rlock("MeshGlobalEngine._lock")
         self.metric_reconciles = 0
         self._req_sharding = mat
         self._warmup()

@@ -109,6 +109,7 @@ from gubernator_tpu.types import (
     Behavior, GlobalUpdate, RateLimitRequest, RateLimitResponse)
 from gubernator_tpu.utils import flightrec, timeutil, tracing
 from gubernator_tpu.utils.hotpath import hot_path
+from gubernator_tpu.utils import sanitize
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -386,10 +387,11 @@ class MeshRaggedTickHandle:
         self.errors = errors
         # Copied: callers may reuse their ReqColumns buffers between
         # submit and resolve (the pipelining pattern).
+        # guber: allow-G001(host column snapshot - limit_req is a host array; the copy is the pipelining contract, not a device sync)
         self._limit_req = np.array(limit_req[:n], np.int64, copy=True)
         self._wt_args = wt_args
         self._done: Optional[np.ndarray] = None
-        self._flock = threading.Lock()
+        self._flock = sanitize.lock("MeshRaggedTickHandle._flock")
 
     def _finish(self, raw: np.ndarray) -> None:
         with self._flock:
@@ -489,7 +491,7 @@ class MeshTickEngine:
         # not treat them as dead (see TickEngine._pending).
         self._pending: set = set()
         self._tick_count = 0
-        self._lock = threading.RLock()
+        self._lock = sanitize.rlock("MeshTickEngine._lock")
         # Flat-upload staging ring + overlap telemetry (the PR 6
         # double-buffered H2D pipeline, shared via ops.engine.StagingRing;
         # sentinel is the GLOBAL capacity — flat padding lanes belong to
@@ -540,10 +542,12 @@ class MeshTickEngine:
             self.state, resp = self.ops.tick_ragged(
                 self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
             )
+            # guber: allow-G001(init-time warmup D2H - deliberately materializes once at engine construction to pre-compile; never inside a serving tick)
             np.asarray(resp)  # warm the response D2H path
             self.state, resp = self.ops.run_tick_ragged_unique(
                 self.state, jnp.asarray(m), jnp.asarray(offs), jnp.int64(0)
             )
+            # guber: allow-G001(init-time warmup D2H - same as above)
             np.asarray(resp)
         cols = np.zeros((self.n_shards, 8, 1), np.int64)  # valid=0: no-op
         self.state = self.ops.install(
@@ -551,6 +555,7 @@ class MeshTickEngine:
         )
         # Pre-compile the per-shard reclaim dead-scan (see TickEngine).
         self._shard_dead_mask(0, 0)
+        # guber: allow-G001(init-time warmup barrier - construction completes only when the device programs are resident)
         jax.block_until_ready(self.state)
 
     def h2d_overlap_ratio(self) -> float:
@@ -607,6 +612,7 @@ class MeshTickEngine:
         if self._pending:
             pend = [g - lo for g in self._pending if lo <= g < lo + self.local_capacity]
             if pend:
+                # guber: allow-G001(host index build over a small python set - no device data; reclaim runs at most once per full shard, not per tick)
                 mapped[np.asarray(pend, np.int64)] = False
         freed, victims = select_reclaim_victims(
             mapped,

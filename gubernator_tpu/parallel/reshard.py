@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from gubernator_tpu.utils import sanitize
 import time
 from typing import Callable, Optional
 
@@ -128,7 +129,7 @@ class ReshardCoordinator:
         self.metrics = metrics
         self.freeze_timeout = float(freeze_timeout)
         self.verify = bool(verify)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("ReshardCoordinator._lock")
         self._epoch = 0
         self.phase = PHASE_IDLE
         self.last: dict = {}

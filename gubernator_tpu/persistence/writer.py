@@ -29,6 +29,7 @@ from typing import List, Optional
 
 from gubernator_tpu.persistence.snapshot import SnapshotStore, snapshot_items
 from gubernator_tpu.resilience import spawn_supervised
+from gubernator_tpu.utils import sanitize
 
 log = logging.getLogger("gubernator.persistence")
 
@@ -55,7 +56,7 @@ class SnapshotWriter:
         # loop task while its executor thread is still inside flush(),
         # then run the final base on another thread — the store's log
         # rotation must never interleave with an append.
-        self._write_lock = threading.Lock()
+        self._write_lock = sanitize.lock("SnapshotWriter._write_lock")
         self._task: Optional[asyncio.Task] = None
         # Host-side counters (mirrored into Prometheus when wired).
         self.metric_delta_writes = 0
@@ -105,6 +106,7 @@ class SnapshotWriter:
             written = 0
             for s in batch:
                 try:
+                    # guber: allow-G007(_write_lock exists to serialize writer I/O against close; it is never taken on the serving path, so blocking under it is its purpose)
                     self.store.append_delta(s)
                 except OSError as e:
                     # The engine's dirty set is already reset: losing
@@ -122,6 +124,7 @@ class SnapshotWriter:
                 self.metric_items_written += n
                 self._observe("delta", time.perf_counter() - t0, n)
             if self.store.delta_records >= self.deltas_per_base:
+                # guber: allow-G007(writer-only lock - see append_delta above)
                 self._write_base_locked()
             return written
 
@@ -130,6 +133,7 @@ class SnapshotWriter:
         next generation's base (carried deltas fold in for free — a full
         export supersedes every delta)."""
         with self._write_lock:
+            # guber: allow-G007(writer-only lock - see append_delta above)
             self._write_base_locked()
 
     def _write_base_locked(self) -> None:

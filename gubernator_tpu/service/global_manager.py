@@ -125,9 +125,16 @@ class GlobalManager:
             # applied FROM a peer region).
             self.federation.queue(req)
         key = req.hash_key()
-        self._updates[key] = req
+        # Broadcast and ring-handoff redelivery are post-answer background
+        # work: a serving-path admission deadline must not ride the stored
+        # copy (queue_hit's rule, now enforced package-wide by G010) — an
+        # owner outage longer than the budget would otherwise expire every
+        # redelivery before its RPC and the state change could never land.
+        clone = RateLimitRequest(**vars(req))
+        clone.deadline = None
+        self._updates[key] = clone
         if key in self._owned or len(self._owned) < self.resilience.redelivery_limit:
-            self._owned[key] = req
+            self._owned[key] = clone
         else:
             # Tracker full (GUBER_REDELIVERY_LIMIT): this key's state will
             # NOT ride a ring-swap handoff.  Never silent — at reshard

@@ -56,6 +56,7 @@ _EMPTY_MATRIX = np.zeros((5, 0), np.int64)
 # read froze the knob for the whole process, so config changes and
 # tests silently saw the stale value).
 from gubernator_tpu.config import env_knob
+from gubernator_tpu.utils import sanitize
 
 DEFAULT_PIPELINE_DEPTH = 4
 
@@ -143,7 +144,7 @@ class TickLoop:
         self._synced_shed = 0
         self._synced_routed = 0
         self._synced_routed_overflows = 0
-        self._cond = threading.Condition()
+        self._cond = sanitize.condition("TickLoop._cond")
         self._pending_count = 0
         self._running = True
         # Reshard admission freeze (docs/resharding.md): level 1 sheds
@@ -256,38 +257,54 @@ class TickLoop:
     @hot_path
     def _run(self) -> None:
         while True:
+            batch: List[QueueItem] = []
+            stopping = False
             with self._cond:
                 while self._running and not self._queue:
                     self._cond.wait()
                 if not self._running and not self._queue:
-                    self._resolve_q.put(None)  # drain + stop the resolver
-                    return
-                # Batch window: once something is queued, wait out the tick
-                # (or until the batch fills) to let more requests coalesce.
-                deadline = time.monotonic() + self.batch_wait
-                while (
-                    self._running
-                    and self._pending_count < self.batch_limit
-                ):
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(timeout=remaining)
-                # Admitted window width: the AIMD limiter narrows it under
-                # measured saturation; shutdown drains at full width so a
-                # throttled loop still closes promptly.  Whatever does not
-                # fit stays queued (in priority order) for the next tick.
-                width = self.batch_limit
-                if self._running and self.limiter.enabled:
-                    width = min(width, self.limiter.window_limit)
-                batch = self._queue.pop_window(width)
-                self._pending_count = self._queue.requests
-                # Count the window from the moment it leaves the queue:
-                # quiesce must see a batch wedged inside engine dispatch
-                # (it is neither queued nor at the resolver yet, but the
-                # cutover cannot run until it resolves).
-                if batch:
-                    self._inflight_windows += 1
+                    stopping = True
+                else:
+                    # Batch window: once something is queued, wait out the
+                    # tick (or until the batch fills) to let more requests
+                    # coalesce.
+                    deadline = time.monotonic() + self.batch_wait
+                    while (
+                        self._running
+                        and self._pending_count < self.batch_limit
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                    # Admitted window width: the AIMD limiter narrows it
+                    # under measured saturation; shutdown drains at full
+                    # width so a throttled loop still closes promptly.
+                    # Whatever does not fit stays queued (in priority
+                    # order) for the next tick.
+                    width = self.batch_limit
+                    if self._running and self.limiter.enabled:
+                        width = min(width, self.limiter.window_limit)
+                    batch = self._queue.pop_window(width)
+                    # Written only under _cond; the one unlocked reader is
+                    # under_pressure(), a per-grant heuristic that tolerates
+                    # one-tick staleness of a GIL-atomic int by design.
+                    # guber: allow-g009(advisory queue-depth mirror - the unlocked under_pressure read tolerates one-tick staleness of a GIL-atomic int)
+                    self._pending_count = self._queue.requests
+                    # Count the window from the moment it leaves the queue:
+                    # quiesce must see a batch wedged inside engine dispatch
+                    # (it is neither queued nor at the resolver yet, but the
+                    # cutover cannot run until it resolves).
+                    if batch:
+                        self._inflight_windows += 1
+            if stopping:
+                # The drain/stop sentinel ships OUTSIDE the condition: the
+                # resolver handoff queue is bounded, and a full pipeline
+                # must park the dispatch thread without wedging every
+                # _cond waiter behind it (guberlint G007).
+                # guber: allow-G001(shutdown-only drain sentinel - runs once at loop exit, never inside a serving tick)
+                self._resolve_q.put(None)
+                return
             if batch:
                 self._flush(batch)
 
@@ -377,6 +394,7 @@ class TickLoop:
         # in flight (device behind), which is exactly the backpressure the
         # dispatch thread should feel.  The in-flight count was taken at
         # pop time in _run; the resolver releases it after the D2H drain.
+        # guber: allow-G001(deliberate bounded-pipeline backpressure - blocking here when pipeline_depth windows are in flight IS the flow control)
         self._resolve_q.put((subs, time.perf_counter() - t0, wid))
 
     def _window_done(self) -> None:

@@ -440,6 +440,7 @@ class Daemon:
 
     async def start(self) -> None:
         """Bring up instance, gRPC, gateway, discovery (daemon.go:83-366)."""
+        # guber: allow-G002(startup-only TLS material read - runs once before any listener accepts traffic)
         self.tls = setup_tls(self.conf.tls)
         options = [("grpc.max_receive_message_length", MAX_RECV_BYTES)]
         if self.conf.grpc_max_conn_age_sec > 0:

@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from gubernator_tpu.utils.hotpath import hot_path
+from gubernator_tpu.utils import sanitize
 
 STAGES = (
     "decode", "lease", "pack", "ssd", "h2d", "tick", "resolve", "encode",
@@ -68,7 +69,7 @@ class FlightRecorder:
         # Optional sink: called as observer(stage, seconds) at finish()
         # (the daemon wires it to the per-stage latency histogram).
         self.observer: Optional[Callable[[str, float], None]] = None
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("FlightRecorder._lock")
         self._stage_s = np.zeros((windows, len(STAGES)), np.float64)
         self._width = np.zeros(windows, np.int64)
         self._depth = np.zeros(windows, np.int64)

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Dict, Optional, Sequence, Tuple
 
+from gubernator_tpu.utils import sanitize
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -104,7 +106,7 @@ class Histogram:
         self._bounds = tuple(buckets if buckets is not None else DEFAULT_BUCKETS)
         if list(self._bounds) != sorted(self._bounds):
             raise ValueError("histogram buckets must be sorted")
-        self._lock = threading.Lock()  # guards child creation only
+        self._lock = sanitize.lock("Histogram._lock")  # guards child creation only
         self._children: Dict[Tuple[str, ...], _HistogramChild] = {}
         if not self._labelnames:
             self._children[()] = _HistogramChild(self._bounds)

@@ -1,0 +1,337 @@
+"""Runtime concurrency sanitizers: the dynamic twin of guberlint.
+
+``GUBER_SANITIZERS=1`` turns every named lock in the package into a
+tracked wrapper feeding a per-process lock-order DAG, and arms the shm
+slab rings' single-writer checks (docs/concurrency.md).  The static
+rules (G007/G008/G009 in :mod:`gubernator_tpu.analysis`) prove what the
+AST can see; these sanitizers catch what it cannot — orders that only
+materialize under a particular interleaving, writer threads that only
+exist behind a config flag — and they fail loudly at the *first*
+violating acquisition, with both stacks, instead of deadlocking later.
+
+Zero cost when off is a hard contract: :func:`lock`, :func:`rlock` and
+:func:`condition` return the bare stdlib primitive (``type(lock("x"))
+is type(threading.Lock())``), and the ring hooks collapse to a single
+``is not None`` test.  The env knob is read once at import; tests that
+need the tracked path construct :class:`LockOrderTracker` /
+:class:`SlabStateSanitizer` directly or pass ``enabled=True`` to the
+factories rather than mutating the environment.
+
+Lock identity is the *name* (class-scoped, e.g. ``"TickEngine._lock"``)
+not the instance, mirroring guberlint's G008 identity rule: two
+engines' ``_lock`` instances never deadlock each other, but an ordering
+inversion between the classes is a bug wherever the instances live.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+from gubernator_tpu.config import env_knob
+
+
+def _parse_flag(v: str) -> bool:
+    return v.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+_ENABLED: bool = bool(env_knob("GUBER_SANITIZERS", False, parse=_parse_flag))
+
+
+def enabled() -> bool:
+    """Whether the sanitizers were armed at process start."""
+    return _ENABLED
+
+
+class LockOrderViolation(AssertionError):
+    """Two lock names were acquired in both orders somewhere in this
+    process — a latent deadlock.  The message carries the stack that
+    recorded the first order and the stack that just inverted it."""
+
+
+class SingleWriterViolation(AssertionError):
+    """An shm ring slab-state transition was driven from the wrong
+    thread (SPSC role pin) or from an illegal prior state."""
+
+
+class LockOrderTracker:
+    """Process-wide happens-in-this-order DAG over lock *names*.
+
+    Every acquisition taken while other locks are held records
+    ``outer -> inner`` edges with the acquiring stack; the first
+    acquisition that would close a cycle raises
+    :class:`LockOrderViolation` before the process can deadlock.
+    Reentrant acquisition of a name already on the thread's held stack
+    (RLocks, condition reacquire) records no edge.
+    """
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        # (outer, inner) -> formatted stack of the acquisition that
+        # first established the order.
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._tls = threading.local()
+
+    def held(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Node path src -> ... -> dst over recorded edges, or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for (a, b) in self._edges:
+                if a == node and b not in seen:
+                    if b == dst:
+                        return path + [b]
+                    seen.add(b)
+                    stack.append((b, path + [b]))
+        return None
+
+    def note_acquired(self, name: str) -> None:
+        held = self.held()
+        if held and name not in held:
+            here = "".join(traceback.format_stack(limit=16))
+            with self._mu:
+                for outer in held:
+                    key = (outer, name)
+                    if key in self._edges:
+                        continue
+                    path = self._find_path(name, outer)
+                    if path is not None:
+                        prior = self._edges[(path[0], path[1])]
+                        chain = " -> ".join(path + [name])
+                        raise LockOrderViolation(
+                            f"lock-order inversion: acquiring '{name}' "
+                            f"while holding '{outer}', but the reverse "
+                            f"order {chain} is already on record.\n"
+                            f"--- stack that recorded "
+                            f"'{path[0]}' -> '{path[1]}':\n{prior}"
+                            f"--- stack acquiring '{name}' now:\n{here}"
+                        )
+                    self._edges[key] = here
+        held.append(name)
+
+    def note_released(self, name: str) -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def reset(self) -> None:
+        """Forget recorded edges (test isolation); held stacks are
+        thread-local and drain naturally."""
+        with self._mu:
+            self._edges.clear()
+
+
+# The process-wide tracker all factory-made locks feed.
+TRACKER = LockOrderTracker()
+
+
+class _TrackedLock:
+    """``threading.Lock``/``RLock`` wrapper feeding the order DAG.
+    Signature-compatible with the stdlib primitive; unknown attributes
+    delegate to the inner lock."""
+
+    __slots__ = ("_name", "_inner", "_tracker")
+
+    def __init__(self, name: str, inner, tracker: LockOrderTracker):
+        self._name = name
+        self._inner = inner
+        self._tracker = tracker
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                self._tracker.note_acquired(self._name)
+            except BaseException:
+                # Don't wedge other threads behind a lock the violating
+                # acquisition will never release.
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._tracker.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:
+        return f"<sanitized {self._inner!r} name={self._name!r}>"
+
+
+class _TrackedCondition:
+    """``threading.Condition`` wrapper: acquire/release feed the order
+    DAG, and ``wait``/``wait_for`` mirror the condition's internal
+    release-reacquire so a parked waiter neither poisons the DAG nor
+    misses the edges its reacquisition creates."""
+
+    __slots__ = ("_name", "_inner", "_tracker")
+
+    def __init__(self, name: str, inner: threading.Condition,
+                 tracker: LockOrderTracker):
+        self._name = name
+        self._inner = inner
+        self._tracker = tracker
+
+    def acquire(self, *args) -> bool:
+        got = self._inner.acquire(*args)
+        if got:
+            self._tracker.note_acquired(self._name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._tracker.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._tracker.note_released(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._tracker.note_acquired(self._name)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._tracker.note_released(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._tracker.note_acquired(self._name)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:
+        return f"<sanitized {self._inner!r} name={self._name!r}>"
+
+
+def lock(name: str, enabled: Optional[bool] = None):
+    """A ``threading.Lock`` — bare when the sanitizers are off (the
+    zero-cost contract), order-tracked under ``name`` when on."""
+    if not (_ENABLED if enabled is None else enabled):
+        return threading.Lock()
+    return _TrackedLock(name, threading.Lock(), TRACKER)
+
+
+def rlock(name: str, enabled: Optional[bool] = None):
+    """A ``threading.RLock`` — bare when off, order-tracked when on
+    (reentrant re-acquisition records no edge)."""
+    if not (_ENABLED if enabled is None else enabled):
+        return threading.RLock()
+    return _TrackedLock(name, threading.RLock(), TRACKER)
+
+
+def condition(name: str, enabled: Optional[bool] = None):
+    """A ``threading.Condition`` — bare when off, order-tracked when on
+    with wait()'s release/reacquire mirrored into the held stack."""
+    if not (_ENABLED if enabled is None else enabled):
+        return threading.Condition()
+    return _TrackedCondition(name, threading.Condition(), TRACKER)
+
+
+class SlabStateSanitizer:
+    """Single-writer discipline for one shm slab ring, per process.
+
+    The rings' SPSC contract (shmring.py docstring) says each ring has
+    exactly one producer and one consumer; this pins the first thread
+    seen in each role and asserts every later transition comes from the
+    pinned thread.  ``free`` is the deliberate exception: a leased slab
+    may be released from any thread (the resolver thread carries the
+    :class:`ShmSlabLease`), so legality there is by *prior state*, not
+    by role — freeing a slab that was popped (leased here) is the
+    contract, freeing a PUBLISHED-never-popped slab loses a request and
+    asserts, and freeing an already-FREE slab is tolerated (an
+    idempotent stale release after :meth:`note_reset`).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self._producer: Optional[int] = None
+        self._consumer: Optional[int] = None
+        self._leased: Set[int] = set()
+
+    def _pin(self, role: str, current: Optional[int]) -> int:
+        me = threading.get_ident()
+        if current is not None and current != me:
+            raise SingleWriterViolation(
+                f"{self.name}: {role} role is pinned to thread "
+                f"{current} but thread {me} drove a {role} transition "
+                f"— the ring's SPSC contract has two {role}s.\n"
+                + "".join(traceback.format_stack(limit=16))
+            )
+        return me
+
+    def note_publish(self, idx: int) -> None:
+        with self._mu:
+            self._producer = self._pin("producer", self._producer)
+
+    def note_pop(self, idx: int) -> None:
+        with self._mu:
+            self._consumer = self._pin("consumer", self._consumer)
+            self._leased.add(idx)
+
+    def note_free(self, idx: int, was_published: bool) -> None:
+        with self._mu:
+            if idx in self._leased:
+                self._leased.discard(idx)
+                return
+            if was_published:
+                raise SingleWriterViolation(
+                    f"{self.name}: slab {idx} freed while PUBLISHED and "
+                    f"never popped — a request the consumer still owes "
+                    f"an answer for was silently dropped.\n"
+                    + "".join(traceback.format_stack(limit=16))
+                )
+            # FREE -> FREE: stale idempotent release after a reset.
+
+    def note_reset(self) -> None:
+        """Crash recovery re-legitimizes new role threads and drops
+        every outstanding lease."""
+        with self._mu:
+            self._producer = None
+            self._consumer = None
+            self._leased.clear()
+
+
+def ring_sanitizer(name: str,
+                   enabled: Optional[bool] = None
+                   ) -> Optional[SlabStateSanitizer]:
+    """A fresh per-ring :class:`SlabStateSanitizer`, or None when the
+    sanitizers are off — callers gate every hook on ``is not None`` so
+    the off path is one attribute test."""
+    if not (_ENABLED if enabled is None else enabled):
+        return None
+    return SlabStateSanitizer(name)

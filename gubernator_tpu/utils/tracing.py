@@ -34,6 +34,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
+from gubernator_tpu.utils import sanitize
+
 TRACEPARENT = "traceparent"
 # W3C trace-context: version 00 is exactly 4 fields; a higher version may
 # append fields after the flags, and receivers must parse the first four
@@ -116,7 +118,7 @@ class InMemoryExporter(SpanExporter):
 
     def __init__(self, cap: int = 4096):
         self.spans: deque = deque(maxlen=cap)
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("InMemoryExporter._lock")
 
     def export(self, span: Span) -> None:
         with self._lock:

@@ -1,0 +1,470 @@
+"""guberlint v2 (analysis/callgraph.py + analysis/concurrency.py):
+call-graph resolution and the interprocedural concurrency rules
+G007-G010, each fixture shaped like the shipped bug its rule encodes.
+
+Deliberately jax-free, like test_static_analysis.py: everything here is
+AST walking over tiny fixture projects.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from gubernator_tpu.analysis import load_project, run_project
+from gubernator_tpu.analysis.callgraph import CallGraph
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MINI_CONFIG = 'ENV_REGISTRY = {\n    "GUBER_GOOD_KNOB": "a knob",\n}\n'
+MINI_CONF = "# GUBER_GOOD_KNOB=1\n"
+
+
+def make_project(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "utils" / "__init__.py").write_text("")
+    (pkg / "config.py").write_text(MINI_CONFIG)
+    (tmp_path / "example.conf").write_text(MINI_CONF)
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return load_project(str(tmp_path), "pkg")
+
+
+def findings(tmp_path, files, rule):
+    return run_project(make_project(tmp_path, files), rule_ids=[rule]).findings
+
+
+# ----------------------------------------------------------------------
+# Call graph resolution
+# ----------------------------------------------------------------------
+def test_callgraph_resolves_methods_functions_and_nested_defs(tmp_path):
+    proj = make_project(tmp_path, {
+        "a.py": """\
+        def helper():
+            return 1
+
+        def outer():
+            def inner():
+                return helper()
+            return inner()
+
+        class C:
+            def run(self):
+                return self.step()
+
+            def step(self):
+                return helper()
+        """,
+    })
+    cg = CallGraph.of(proj)
+    run = cg.functions["pkg.a.C.run"]
+    assert [c.qname for c, _ln in cg.edges(run)] == ["pkg.a.C.step"]
+    step = cg.functions["pkg.a.C.step"]
+    assert [c.qname for c, _ln in cg.edges(step)] == ["pkg.a.helper"]
+    inner = cg.functions["pkg.a.outer.<locals>.inner"]
+    assert [c.qname for c, _ln in cg.edges(inner)] == ["pkg.a.helper"]
+    outer = cg.functions["pkg.a.outer"]
+    assert [c.qname for c, _ln in cg.edges(outer)] == [
+        "pkg.a.outer.<locals>.inner"]
+
+
+def test_callgraph_resolves_aliased_and_from_imports(tmp_path):
+    proj = make_project(tmp_path, {
+        "lib.py": "def work():\n    return 1\n",
+        "user1.py": "import pkg.lib as l\n\ndef f():\n    return l.work()\n",
+        "user2.py": "from pkg.lib import work\n\ndef g():\n    return work()\n",
+    })
+    cg = CallGraph.of(proj)
+    for fn in ("pkg.user1.f", "pkg.user2.g"):
+        assert [c.qname for c, _ln in cg.edges(cg.functions[fn])] == [
+            "pkg.lib.work"], fn
+
+
+def test_callgraph_dynamic_dispatch_resolves_to_nothing(tmp_path):
+    """A callable behind an un-inferable attribute produces NO edge —
+    the documented best-effort contract that keeps the transitive rules
+    free of dynamic-dispatch false positives."""
+    proj = make_project(tmp_path, {
+        "a.py": """\
+        class C:
+            def __init__(self, cb):
+                self.cb = cb
+
+            def run(self):
+                return self.cb()
+        """,
+    })
+    cg = CallGraph.of(proj)
+    assert cg.edges(cg.functions["pkg.a.C.run"]) == []
+
+
+def test_callgraph_infers_self_attr_types_from_ctor(tmp_path):
+    proj = make_project(tmp_path, {
+        "a.py": """\
+        import queue
+
+        class C:
+            def __init__(self):
+                self._q = queue.Queue()
+        """,
+    })
+    cg = CallGraph.of(proj)
+    assert cg.classes["pkg.a.C"].attr_types["_q"] == "queue.Queue"
+
+
+# ----------------------------------------------------------------------
+# G007 — blocking call under a held lock (transitive)
+# ----------------------------------------------------------------------
+G007_DIRECT = """\
+import threading
+import time
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def sink(self):
+        with self._lock:
+            time.sleep(0.1)
+"""
+
+
+def test_g007_direct_blocking_under_lock(tmp_path):
+    out = findings(tmp_path, {"mod.py": G007_DIRECT}, "G007")
+    assert len(out) == 1
+    assert "time.sleep" in out[0].message
+    assert "Store._lock" in out[0].message
+
+
+def test_g007_transitive_through_helper_chain(tmp_path):
+    src = """\
+    import threading
+
+    def read_file(path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def load(path):
+        return read_file(path)
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def fetch(self, path):
+            with self._lock:
+                return load(path)
+    """
+    out = findings(tmp_path, {"mod.py": src}, "G007")
+    assert len(out) == 1
+    assert "'load'" in out[0].message and "open" in out[0].message
+
+
+def test_g007_negatives(tmp_path):
+    src = """\
+    import threading
+    import time
+
+    async def poller():
+        pass
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = __import__("queue").Queue()
+
+        def ok_outside(self):
+            with self._lock:
+                x = 1
+            time.sleep(0.1)
+            return x
+
+        def ok_lock_method(self, other_lock):
+            with self._lock:
+                other_lock.acquire()
+                other_lock.release()
+
+        def ok_nonblocking_queue(self):
+            with self._lock:
+                self._q.put_nowait(1)
+                self._q.get(block=False)
+    """
+    assert findings(tmp_path, {"mod.py": src}, "G007") == []
+
+
+def test_g007_allow_on_primitive_line_covers_all_callers(tmp_path):
+    """One allow-comment at the blocking primitive suppresses every
+    transitive caller — the shared-helper suppression contract."""
+    src = """\
+    import threading
+    import time
+
+    def backoff():
+        # guber: allow-G007(test fixture - deliberate serialized wait)
+        time.sleep(0.1)
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                backoff()
+
+    class B:
+        def __init__(self):
+            self._block = threading.Lock()
+
+        def g(self):
+            with self._block:
+                backoff()
+    """
+    assert findings(tmp_path, {"mod.py": src}, "G007") == []
+
+
+# ----------------------------------------------------------------------
+# G008 — lock-order cycles
+# ----------------------------------------------------------------------
+G008_POS = """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._lock1 = threading.Lock()
+        self._lock2 = threading.Lock()
+
+    def ab(self):
+        with self._lock1:
+            with self._lock2:
+                return 1
+
+    def ba(self):
+        with self._lock2:
+            with self._lock1:
+                return 2
+"""
+
+
+def test_g008_inverted_nesting_is_a_cycle(tmp_path):
+    out = findings(tmp_path, {"mod.py": G008_POS}, "G008")
+    assert len(out) == 1
+    assert "Pair._lock1" in out[0].message
+    assert "Pair._lock2" in out[0].message
+
+
+def test_g008_cycle_through_a_call(tmp_path):
+    """The inversion hides behind a method call: ab nests directly,
+    ba holds lock2 and calls a helper that takes lock1."""
+    src = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._lock1 = threading.Lock()
+            self._lock2 = threading.Lock()
+
+        def ab(self):
+            with self._lock1:
+                with self._lock2:
+                    return 1
+
+        def helper(self):
+            with self._lock1:
+                return 2
+
+        def ba(self):
+            with self._lock2:
+                return self.helper()
+    """
+    out = findings(tmp_path, {"mod.py": src}, "G008")
+    assert len(out) == 1
+
+
+def test_g008_consistent_order_is_clean(tmp_path):
+    src = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._lock1 = threading.Lock()
+            self._lock2 = threading.Lock()
+
+        def ab(self):
+            with self._lock1:
+                with self._lock2:
+                    return 1
+
+        def also_ab(self):
+            with self._lock1:
+                with self._lock2:
+                    return 2
+    """
+    assert findings(tmp_path, {"mod.py": src}, "G008") == []
+
+
+# ----------------------------------------------------------------------
+# G009 — unguarded cross-thread shared state
+# ----------------------------------------------------------------------
+G009_POS = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.count += 1
+
+    def snapshot(self):
+        return self.count
+"""
+
+
+def test_g009_thread_written_attr_read_unguarded(tmp_path):
+    out = findings(tmp_path, {"mod.py": G009_POS}, "G009")
+    assert len(out) == 1
+    assert "self.count" in out[0].message
+    assert "_run" in out[0].message
+
+
+def test_g009_allow_comment_suppresses(tmp_path):
+    src = G009_POS.replace(
+        "        self.count += 1",
+        "        # guber: allow-g009(test fixture - GIL-atomic int, "
+        "one-tick staleness tolerated)\n        self.count += 1",
+    )
+    res = run_project(make_project(tmp_path, {"mod.py": src}),
+                      rule_ids=["G009"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_g009_negatives(tmp_path):
+    src = """\
+    import queue
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+            self.guarded = 0
+            self.metric_ticks = 0
+            self._running = True
+
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            with self._lock:
+                self.guarded += 1        # both sides guarded
+            self._q.put_nowait(1)        # thread-safe type
+            self.metric_ticks += 1       # telemetry convention
+            self._running = True         # const-only flag writes
+
+        def read(self):
+            with self._lock:
+                return self.guarded
+
+        def stop(self):
+            self._running = False
+    """
+    assert findings(tmp_path, {"mod.py": src}, "G009") == []
+
+
+# ----------------------------------------------------------------------
+# G010 — deadline taint into supervised background queues
+# ----------------------------------------------------------------------
+G010_PRELUDE = """\
+from pkg.types import Req
+from pkg.utils.supervisor import spawn_supervised
+
+class Manager:
+    def __init__(self):
+        self._updates = {}
+        spawn_supervised(self._loop)
+
+    async def _loop(self):
+        self._updates.clear()
+
+"""
+
+G010_TYPES = """\
+class Req:
+    deadline: float = 0.0
+    name: str = ""
+"""
+
+G010_SUP = "def spawn_supervised(factory):\n    return factory\n"
+
+
+def _g010_files(method_body: str):
+    return {
+        "types.py": G010_TYPES,
+        "utils/supervisor.py": G010_SUP,
+        "mgr.py": G010_PRELUDE + textwrap.indent(
+            textwrap.dedent(method_body), "    "),
+    }
+
+
+def test_g010_tainted_store_flags(tmp_path):
+    out = findings(tmp_path, _g010_files("""\
+    def queue_update(self, req: Req):
+        self._updates[req.name] = req
+    """), "G010")
+    assert len(out) == 1
+    assert "deadline" in out[0].message and "_loop" in out[0].message
+
+
+def test_g010_clone_keeps_taint(tmp_path):
+    out = findings(tmp_path, _g010_files("""\
+    def queue_update(self, req: Req):
+        clone = Req(**vars(req))
+        self._updates[req.name] = clone
+    """), "G010")
+    assert len(out) == 1
+
+
+def test_g010_cleared_deadline_is_clean(tmp_path):
+    out = findings(tmp_path, _g010_files("""\
+    def queue_update(self, req: Req):
+        clone = Req(**vars(req))
+        clone.deadline = None
+        self._updates[req.name] = clone
+    """), "G010")
+    assert out == []
+
+
+def test_g010_explicit_deadline_kwarg_is_author_decided(tmp_path):
+    out = findings(tmp_path, _g010_files("""\
+    def queue_update(self, req: Req):
+        clone = Req(deadline=None, name=req.name)
+        self._updates[req.name] = clone
+    """), "G010")
+    assert out == []
+
+
+def test_g010_store_into_undrained_container_is_clean(tmp_path):
+    """Containers the supervised loop never touches are not its
+    problem — only loop-drained attrs taint."""
+    out = findings(tmp_path, _g010_files("""\
+    def queue_update(self, req: Req):
+        self._elsewhere = {}
+        self._elsewhere[req.name] = req
+    """), "G010")
+    assert out == []
+
+
+# ----------------------------------------------------------------------
+# The repo itself under the new rules
+# ----------------------------------------------------------------------
+def test_repo_is_clean_under_concurrency_rules():
+    """The zero-findings gate, restricted to G007-G010: every real
+    finding at rule-introduction time was fixed or reason-suppressed."""
+    proj = load_project(REPO_ROOT, "gubernator_tpu")
+    res = run_project(proj, rule_ids=["G007", "G008", "G009", "G010"])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)

@@ -1,0 +1,241 @@
+"""Runtime sanitizers (utils/sanitize.py): the zero-cost-off contract,
+the lock-order DAG's inversion assert (with both stacks), condition
+wait release/reacquire mirroring, and the shm ring SPSC single-writer
+pins — including the wired hooks in edge/shmring.py.
+
+jax-free: sanitize imports only config; shmring imports numpy/reqcols.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gubernator_tpu.utils import sanitize
+from gubernator_tpu.utils.sanitize import (
+    LockOrderTracker,
+    LockOrderViolation,
+    SingleWriterViolation,
+    SlabStateSanitizer,
+)
+
+
+@pytest.fixture
+def tracker():
+    """Fresh process-wide edge set per test (the module tracker is
+    shared state by design)."""
+    sanitize.TRACKER.reset()
+    yield sanitize.TRACKER
+    sanitize.TRACKER.reset()
+
+
+# ----------------------------------------------------------------------
+# The zero-cost-off contract
+# ----------------------------------------------------------------------
+def test_off_mode_returns_bare_stdlib_primitives():
+    assert type(sanitize.lock("x")) is type(threading.Lock())
+    assert type(sanitize.rlock("x")) is type(threading.RLock())
+    assert type(sanitize.condition("x")) is threading.Condition
+    assert sanitize.ring_sanitizer("r") is None
+
+
+def test_on_mode_returns_tracked_wrappers():
+    lk = sanitize.lock("x", enabled=True)
+    assert type(lk) is not type(threading.Lock())
+    assert sanitize.ring_sanitizer("r", enabled=True) is not None
+
+
+# ----------------------------------------------------------------------
+# Lock-order DAG
+# ----------------------------------------------------------------------
+def test_inversion_asserts_with_both_stacks(tracker):
+    la = sanitize.lock("A", enabled=True)
+    lb = sanitize.lock("B", enabled=True)
+    with la:
+        with lb:
+            pass
+    with pytest.raises(LockOrderViolation) as ei:
+        with lb:
+            with la:
+                pass
+    msg = str(ei.value)
+    assert "stack that recorded" in msg       # the A -> B acquisition
+    assert "acquiring 'A' now" in msg         # the inverting acquisition
+    assert "test_sanitize" in msg             # real stacks, both of them
+    # The violating inner lock was released on the way out — the
+    # process is not wedged behind a lock nobody will release.
+    assert la.acquire(blocking=False)
+    la.release()
+
+
+def test_three_lock_cycle_detected(tracker):
+    a = sanitize.lock("A3", enabled=True)
+    b = sanitize.lock("B3", enabled=True)
+    c = sanitize.lock("C3", enabled=True)
+    with a, b:
+        pass
+    with b, c:
+        pass
+    with pytest.raises(LockOrderViolation):
+        with c, a:
+            pass
+
+
+def test_consistent_order_and_reentrant_rlock_are_clean(tracker):
+    a = sanitize.lock("Ok1", enabled=True)
+    b = sanitize.lock("Ok2", enabled=True)
+    r = sanitize.rlock("OkR", enabled=True)
+    for _ in range(3):
+        with a:
+            with b:
+                with r:
+                    with r:   # reentrant: no self-edge, no violation
+                        pass
+    assert tracker.held() == []
+
+
+def test_inversion_across_threads_is_caught(tracker):
+    """The DAG is process-wide: thread 1 records A -> B, thread 2's
+    B -> A nesting asserts even though neither thread deadlocks alone."""
+    a = sanitize.lock("XT1", enabled=True)
+    b = sanitize.lock("XT2", enabled=True)
+    def t1():
+        with a:
+            with b:
+                pass
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    with pytest.raises(LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_condition_wait_mirrors_release_reacquire(tracker):
+    """A waiter parked in cond.wait() must not hold the cond's slot in
+    the order DAG — acquiring another lock from a second thread while
+    the waiter is parked records no cond -> lock edge."""
+    cond = sanitize.condition("CondM", enabled=True)
+    other = sanitize.lock("OtherM", enabled=True)
+    woke = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            woke.set()
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    with other:
+        pass
+    with cond:
+        cond.notify_all()
+    th.join()
+    assert woke.is_set()
+    # wait()'s reacquire restored the held-stack bookkeeping: the
+    # waiter thread exited its with-block without underflow, and no
+    # CondM edge involving OtherM exists.
+    assert not any("OtherM" in k for k in tracker._edges)
+
+
+# ----------------------------------------------------------------------
+# SPSC slab-state sanitizer
+# ----------------------------------------------------------------------
+def test_slab_roles_pin_to_first_thread():
+    s = SlabStateSanitizer("ring")
+    s.note_publish(0)
+    errs = []
+
+    def other():
+        try:
+            s.note_publish(1)
+        except SingleWriterViolation as e:
+            errs.append(e)
+
+    th = threading.Thread(target=other)
+    th.start()
+    th.join()
+    assert len(errs) == 1 and "producer" in str(errs[0])
+    # Same thread keeps publishing fine; the consumer role pins
+    # independently.
+    s.note_publish(2)
+    s.note_pop(2)
+
+
+def test_slab_free_legality_by_prior_state():
+    s = SlabStateSanitizer("ring")
+    s.note_publish(0)
+    s.note_pop(0)
+    s.note_free(0, was_published=False)            # leased: the contract
+    with pytest.raises(SingleWriterViolation):
+        s.note_free(1, was_published=True)         # published, never popped
+    s.note_free(2, was_published=False)            # stale post-reset: ok
+
+
+def test_slab_reset_clears_pins_and_leases():
+    s = SlabStateSanitizer("ring")
+    s.note_publish(0)
+    s.note_pop(0)
+    s.note_reset()
+    done = []
+
+    def new_producer():
+        s.note_publish(1)
+        done.append(True)
+
+    th = threading.Thread(target=new_producer)
+    th.start()
+    th.join()
+    assert done  # respawn re-legitimizes a new producer thread
+    # The pre-reset lease is gone: freeing it now relies on prior state.
+    s.note_free(0, was_published=False)
+
+
+# ----------------------------------------------------------------------
+# Wired hooks in edge/shmring.py
+# ----------------------------------------------------------------------
+def test_shmring_hooks_enforce_discipline(monkeypatch):
+    from gubernator_tpu.edge import shmring
+
+    monkeypatch.setattr(sanitize, "_ENABLED", True)
+    seg = shmring.EdgeSegment(None, max_batch=4, slabs=2, depth=2,
+                              create=True)
+    try:
+        ring = shmring.RequestRing(seg)
+        assert ring._san is not None
+        idx = ring.try_claim()
+        ring.publish(idx, seqno=1, rows=1, blob_len=0, deadline_ns=0,
+                     decode_ns=0, generation=1)
+        popped = ring.pop_published()
+        assert popped is not None and popped[0] == idx
+        ring.free(idx)                      # leased -> FREE: the contract
+
+        idx2 = ring.try_claim()
+        ring.publish(idx2, seqno=2, rows=1, blob_len=0, deadline_ns=0,
+                     decode_ns=0, generation=1)
+        with pytest.raises(SingleWriterViolation):
+            ring.free(idx2)                 # PUBLISHED, never popped
+        ring.reset()
+        ring.free(idx2)                     # stale release post-reset: ok
+        ring.detach()
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def test_shmring_off_mode_has_no_sanitizer():
+    from gubernator_tpu.edge import shmring
+
+    seg = shmring.EdgeSegment(None, max_batch=4, slabs=2, depth=2,
+                              create=True)
+    try:
+        ring = shmring.RequestRing(seg)
+        resp = shmring.ResponseRing(seg)
+        assert ring._san is None and resp._san is None
+        ring.detach()
+        resp.detach()
+    finally:
+        seg.close()
+        seg.unlink()
